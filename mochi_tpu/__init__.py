@@ -1,0 +1,32 @@
+"""mochi-tpu: a TPU-native Byzantine-fault-tolerant transactional KV store.
+
+A ground-up rebuild of the capabilities of the reference system
+(tomisetsu/mochi-db, a Java 8 / Netty / protobuf HQ-replication-style quorum
+BFT store — see SURVEY.md) as an idiomatic Python + JAX framework:
+
+- ``protocol/``  — message schema + deterministic wire codec (ref: L0,
+  ``server/messages/MochiProtocol.proto``), *plus* the Ed25519 signature
+  envelope the reference left as a TODO (``MochiProtocol.proto:123``).
+- ``net/``       — asyncio TCP transport with msg-id-correlated RPC (ref: L1,
+  ``server/messaging/``; fixes the FIFO-correlation assumption of
+  ``MochiClientHandler.java:67-75``).
+- ``cluster/``   — token-ring sharding + quorum math (ref: L2,
+  ``server/ClusterConfiguration.java``; implements the *intended* ring walk,
+  fixing the lookup bug at ``ClusterConfiguration.java:215``).
+- ``server/``    — replica runtime + datastore state machine (ref: L3-L5,
+  ``server/datastrore/InMemoryDataStore.java``,
+  ``server/messaging/MochiServer.java``).
+- ``client/``    — transaction-coordinating client SDK (ref:
+  ``client/MochiDBClient.java``).
+- ``crypto/``    — Ed25519: pure-Python RFC 8032 reference, and the TPU-native
+  batch verifier (exact int32 limb field arithmetic, vmapped curve ops,
+  jit/shard_map) — the north-star capability (BASELINE.json).
+- ``verifier/``  — the ``SignatureVerifier`` SPI: CPU path (host
+  ``cryptography``/OpenSSL), TPU batching path.
+- ``parallel/``  — device-mesh sharding of verification batches + quorum
+  reductions over ICI (jax.sharding / shard_map).
+- ``testing/``   — in-process virtual cluster (ref:
+  ``testingframework/MochiVirtualCluster.java``).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,3 @@
+from .config import ClusterConfig, ServerInfo, round_robin_token_assignment
+
+__all__ = ["ClusterConfig", "ServerInfo", "round_robin_token_assignment"]

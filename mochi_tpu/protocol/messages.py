@@ -1,0 +1,446 @@
+"""Protocol message vocabulary.
+
+Same message set as the reference schema (``server/messages/MochiProtocol.proto``):
+Operation/Transaction (``:20-43``), OperationResult (``:45-56``),
+Read pair (``:72-87``), Write1ToServer (``:92-97``), Grant/MultiGrant
+(``:107-124``), WriteCertificate (``:126-130``), Write1Ok/Write1Refused
+(``:133-161``), Write2 pair (``:102-105,144-147``), RequestFailed (``:168-174``),
+Hello ping pair (``:176-192``), and the ProtocolMessage envelope (``:194-213``)
+— **plus** the signature fields the reference declared and never implemented
+(``MochiProtocol.proto:116,123``; ``mochiDB.tex:135,202``): every MultiGrant
+and every envelope carries an Ed25519 signature over canonical mcode bytes.
+
+Messages are frozen dataclasses.  ``to_obj``/``from_obj`` convert to/from the
+plain structures that :mod:`mochi_tpu.protocol.codec` encodes; the envelope's
+wire form is ``encode([tag, obj])``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field, replace
+from enum import IntEnum
+from typing import Any, Dict, Optional, Tuple, Type
+
+from .codec import decode, encode
+
+
+class Action(IntEnum):
+    """Operation verbs (ref: ``MochiProtocol.proto:22-27``)."""
+
+    READ = 0
+    WRITE = 1
+    DELETE = 2
+
+
+class Status(IntEnum):
+    """Per-operation / per-grant status (ref: ``MochiProtocol.proto:29-33,49-55``)."""
+
+    OK = 0
+    WRONG_SHARD = 1
+    REFUSED = 2  # grant denied: timestamp already taken by another transaction
+
+
+class FailType(IntEnum):
+    """Request-failure taxonomy (ref: ``MochiProtocol.proto:168-174``)."""
+
+    OLD_REQUEST = 0
+    BAD_SIGNATURE = 1  # new: message failed signature verification
+    BAD_CERTIFICATE = 2  # new: write certificate failed quorum/signature checks
+
+
+# --------------------------------------------------------------------------
+# Transactions
+
+
+@dataclass(frozen=True)
+class Operation:
+    """One read/write/delete (ref: ``MochiProtocol.proto:20-39``;
+    operand1=key, operand2=value)."""
+
+    action: Action
+    key: str
+    value: Optional[bytes] = None
+
+    def to_obj(self) -> Any:
+        return [int(self.action), self.key, self.value]
+
+    @classmethod
+    def from_obj(cls, obj: Any) -> "Operation":
+        action, key, value = obj
+        return cls(Action(action), key, value)
+
+
+@dataclass(frozen=True)
+class Transaction:
+    """Ordered multi-key operation list (ref: ``MochiProtocol.proto:41-43``)."""
+
+    operations: Tuple[Operation, ...]
+
+    def to_obj(self) -> Any:
+        return [op.to_obj() for op in self.operations]
+
+    @classmethod
+    def from_obj(cls, obj: Any) -> "Transaction":
+        return cls(tuple(Operation.from_obj(o) for o in obj))
+
+    @property
+    def keys(self) -> Tuple[str, ...]:
+        return tuple(op.key for op in self.operations)
+
+
+def transaction_hash(txn: Transaction) -> bytes:
+    """SHA-512 over the canonical encoding of the transaction.
+
+    The reference hashes Java serialization bytes (``Utils.java:135-153``);
+    mcode gives a language-independent canonical form instead.
+    """
+    return hashlib.sha512(b"mochi.txn\x00" + encode(txn.to_obj())).digest()
+
+
+# --------------------------------------------------------------------------
+# Grants and certificates
+
+
+@dataclass(frozen=True)
+class Grant:
+    """Per-object write grant for a prospective timestamp
+    (ref: ``MochiProtocol.proto:107-113``)."""
+
+    object_id: str
+    timestamp: int
+    configstamp: int
+    transaction_hash: bytes
+    status: Status = Status.OK
+
+    def to_obj(self) -> Any:
+        return [self.object_id, self.timestamp, self.configstamp, self.transaction_hash, int(self.status)]
+
+    @classmethod
+    def from_obj(cls, obj: Any) -> "Grant":
+        oid, ts, cs, th, st = obj
+        return cls(oid, ts, cs, th, Status(st))
+
+
+@dataclass(frozen=True)
+class MultiGrant:
+    """All grants a single server issues for one Write1, Ed25519-signed by
+    that server (ref: ``MochiProtocol.proto:116-124`` — "MultiGrant, which is
+    signed"; the ``// TODO: add signature`` is implemented here)."""
+
+    grants: Dict[str, Grant]  # object_id -> Grant
+    client_id: str
+    server_id: str
+    signature: Optional[bytes] = None
+
+    def signing_bytes(self) -> bytes:
+        """Canonical bytes covered by the server's signature (excludes the
+        signature field itself)."""
+        return b"mochi.mgrant\x00" + encode(
+            [self.server_id, self.client_id, {k: g.to_obj() for k, g in self.grants.items()}]
+        )
+
+    def with_signature(self, sig: bytes) -> "MultiGrant":
+        return replace(self, signature=sig)
+
+    def to_obj(self) -> Any:
+        return [
+            {k: g.to_obj() for k, g in self.grants.items()},
+            self.client_id,
+            self.server_id,
+            self.signature,
+        ]
+
+    @classmethod
+    def from_obj(cls, obj: Any) -> "MultiGrant":
+        grants, client_id, server_id, sig = obj
+        return cls({k: Grant.from_obj(g) for k, g in grants.items()}, client_id, server_id, sig)
+
+
+@dataclass(frozen=True)
+class WriteCertificate:
+    """2f+1 signed MultiGrants assembled by the client
+    (ref: ``MochiProtocol.proto:126-130``)."""
+
+    grants: Dict[str, MultiGrant]  # server_id -> MultiGrant
+
+    def to_obj(self) -> Any:
+        return {sid: mg.to_obj() for sid, mg in self.grants.items()}
+
+    @classmethod
+    def from_obj(cls, obj: Any) -> "WriteCertificate":
+        return cls({sid: MultiGrant.from_obj(mg) for sid, mg in obj.items()})
+
+
+@dataclass(frozen=True)
+class OperationResult:
+    """Per-operation outcome (ref: ``MochiProtocol.proto:45-56``)."""
+
+    value: Optional[bytes] = None
+    current_certificate: Optional[WriteCertificate] = None
+    existed: bool = False
+    status: Status = Status.OK
+
+    def to_obj(self) -> Any:
+        cc = self.current_certificate.to_obj() if self.current_certificate else None
+        return [self.value, cc, self.existed, int(self.status)]
+
+    @classmethod
+    def from_obj(cls, obj: Any) -> "OperationResult":
+        value, cc, existed, st = obj
+        return cls(value, WriteCertificate.from_obj(cc) if cc is not None else None, existed, Status(st))
+
+
+@dataclass(frozen=True)
+class TransactionResult:
+    """Results aligned with the transaction's operation order
+    (ref: ``MochiProtocol.proto:58-70``)."""
+
+    operations: Tuple[OperationResult, ...]
+
+    def to_obj(self) -> Any:
+        return [op.to_obj() for op in self.operations]
+
+    @classmethod
+    def from_obj(cls, obj: Any) -> "TransactionResult":
+        return cls(tuple(OperationResult.from_obj(o) for o in obj))
+
+
+# --------------------------------------------------------------------------
+# Request / response payloads
+
+
+@dataclass(frozen=True)
+class ReadToServer:
+    """1-round-trip read request (ref: ``MochiProtocol.proto:72-80``)."""
+
+    client_id: str
+    transaction: Transaction
+    nonce: str
+
+    def to_obj(self) -> Any:
+        return [self.client_id, self.transaction.to_obj(), self.nonce]
+
+    @classmethod
+    def from_obj(cls, obj: Any) -> "ReadToServer":
+        cid, txn, nonce = obj
+        return cls(cid, Transaction.from_obj(txn), nonce)
+
+
+@dataclass(frozen=True)
+class ReadFromServer:
+    """Read response (ref: ``MochiProtocol.proto:82-87``)."""
+
+    result: TransactionResult
+    nonce: str
+    rid: str
+
+    def to_obj(self) -> Any:
+        return [self.result.to_obj(), self.nonce, self.rid]
+
+    @classmethod
+    def from_obj(cls, obj: Any) -> "ReadFromServer":
+        res, nonce, rid = obj
+        return cls(TransactionResult.from_obj(res), nonce, rid)
+
+
+@dataclass(frozen=True)
+class Write1ToServer:
+    """Phase-1 write: request grants at epoch+seed
+    (ref: ``MochiProtocol.proto:92-97``)."""
+
+    client_id: str
+    transaction: Transaction
+    seed: int
+    transaction_hash: bytes
+
+    def to_obj(self) -> Any:
+        return [self.client_id, self.transaction.to_obj(), self.seed, self.transaction_hash]
+
+    @classmethod
+    def from_obj(cls, obj: Any) -> "Write1ToServer":
+        cid, txn, seed, th = obj
+        return cls(cid, Transaction.from_obj(txn), seed, th)
+
+
+@dataclass(frozen=True)
+class Write1OkFromServer:
+    """All grants issued (ref: ``MochiProtocol.proto:133-138``)."""
+
+    multi_grant: MultiGrant
+    current_certificates: Dict[str, WriteCertificate] = field(default_factory=dict)
+
+    def to_obj(self) -> Any:
+        return [self.multi_grant.to_obj(), {k: c.to_obj() for k, c in self.current_certificates.items()}]
+
+    @classmethod
+    def from_obj(cls, obj: Any) -> "Write1OkFromServer":
+        mg, ccs = obj
+        return cls(MultiGrant.from_obj(mg), {k: WriteCertificate.from_obj(c) for k, c in ccs.items()})
+
+
+@dataclass(frozen=True)
+class Write1RefusedFromServer:
+    """Some grant denied: carries the conflicting state
+    (ref: ``MochiProtocol.proto:153-161``)."""
+
+    multi_grant: MultiGrant  # statuses indicate per-object grant/refusal
+    current_certificates: Dict[str, WriteCertificate] = field(default_factory=dict)
+    client_id: str = ""
+
+    def to_obj(self) -> Any:
+        return [
+            self.multi_grant.to_obj(),
+            {k: c.to_obj() for k, c in self.current_certificates.items()},
+            self.client_id,
+        ]
+
+    @classmethod
+    def from_obj(cls, obj: Any) -> "Write1RefusedFromServer":
+        mg, ccs, cid = obj
+        return cls(
+            MultiGrant.from_obj(mg),
+            {k: WriteCertificate.from_obj(c) for k, c in ccs.items()},
+            cid,
+        )
+
+
+@dataclass(frozen=True)
+class Write2ToServer:
+    """Phase-2 write: commit with certificate (ref: ``MochiProtocol.proto:144-147``)."""
+
+    write_certificate: WriteCertificate
+    transaction: Transaction
+
+    def to_obj(self) -> Any:
+        return [self.write_certificate.to_obj(), self.transaction.to_obj()]
+
+    @classmethod
+    def from_obj(cls, obj: Any) -> "Write2ToServer":
+        wc, txn = obj
+        return cls(WriteCertificate.from_obj(wc), Transaction.from_obj(txn))
+
+
+@dataclass(frozen=True)
+class Write2AnsFromServer:
+    """Write2 response (ref: ``MochiProtocol.proto:102-105``)."""
+
+    result: TransactionResult
+    rid: str
+
+    def to_obj(self) -> Any:
+        return [self.result.to_obj(), self.rid]
+
+    @classmethod
+    def from_obj(cls, obj: Any) -> "Write2AnsFromServer":
+        res, rid = obj
+        return cls(TransactionResult.from_obj(res), rid)
+
+
+@dataclass(frozen=True)
+class RequestFailedFromServer:
+    """Typed failure response (ref: ``MochiProtocol.proto:168-174``)."""
+
+    fail_type: FailType
+    detail: str = ""
+
+    def to_obj(self) -> Any:
+        return [int(self.fail_type), self.detail]
+
+    @classmethod
+    def from_obj(cls, obj: Any) -> "RequestFailedFromServer":
+        ft, detail = obj
+        return cls(FailType(ft), detail)
+
+
+@dataclass(frozen=True)
+class HelloToServer:
+    """Ping (ref: ``MochiProtocol.proto:176-183``)."""
+
+    message: str = "hello"
+
+    def to_obj(self) -> Any:
+        return [self.message]
+
+    @classmethod
+    def from_obj(cls, obj: Any) -> "HelloToServer":
+        return cls(obj[0])
+
+
+@dataclass(frozen=True)
+class HelloFromServer:
+    """Pong (ref: ``MochiProtocol.proto:185-192``)."""
+
+    message: str = "hello back"
+
+    def to_obj(self) -> Any:
+        return [self.message]
+
+    @classmethod
+    def from_obj(cls, obj: Any) -> "HelloFromServer":
+        return cls(obj[0])
+
+
+# --------------------------------------------------------------------------
+# Envelope
+
+_PAYLOAD_TYPES: Tuple[Type, ...] = (
+    ReadToServer,
+    ReadFromServer,
+    Write1ToServer,
+    Write1OkFromServer,
+    Write1RefusedFromServer,
+    Write2ToServer,
+    Write2AnsFromServer,
+    RequestFailedFromServer,
+    HelloToServer,
+    HelloFromServer,
+)
+_TAG_BY_TYPE = {cls: i for i, cls in enumerate(_PAYLOAD_TYPES)}
+
+
+@dataclass(frozen=True)
+class Envelope:
+    """Wire envelope: payload + correlation ids + sender + signature
+    (ref: ``ProtocolMessage``, ``MochiProtocol.proto:194-213``; msg_id
+    correlation replaces the reference's FIFO promise queue,
+    ``MochiClientHandler.java:67-75``)."""
+
+    payload: Any
+    msg_id: str
+    sender_id: str
+    reply_to: Optional[str] = None
+    timestamp_ms: int = 0
+    signature: Optional[bytes] = None
+
+    def signing_bytes(self) -> bytes:
+        tag = _TAG_BY_TYPE[type(self.payload)]
+        return b"mochi.env\x00" + encode(
+            [tag, self.payload.to_obj(), self.msg_id, self.sender_id, self.reply_to, self.timestamp_ms]
+        )
+
+    def with_signature(self, sig: bytes) -> "Envelope":
+        return replace(self, signature=sig)
+
+
+def encode_envelope(env: Envelope) -> bytes:
+    tag = _TAG_BY_TYPE[type(env.payload)]
+    return encode(
+        [
+            tag,
+            env.payload.to_obj(),
+            env.msg_id,
+            env.sender_id,
+            env.reply_to,
+            env.timestamp_ms,
+            env.signature,
+        ]
+    )
+
+
+def decode_envelope(data: bytes) -> Envelope:
+    tag, payload_obj, msg_id, sender_id, reply_to, ts, sig = decode(data)
+    if not 0 <= tag < len(_PAYLOAD_TYPES):
+        raise ValueError(f"unknown payload tag {tag}")
+    payload = _PAYLOAD_TYPES[tag].from_obj(payload_obj)
+    return Envelope(payload, msg_id, sender_id, reply_to, ts, sig)

@@ -1,0 +1,100 @@
+"""Cluster config: token ring, replica sets, quorum math, properties parsing.
+
+Mirrors ``server/ClusterConfigurationTest.java``: token arithmetic (``:21-45``)
+and key distribution over a bootstrap ring (``:47-103``) — and additionally
+asserts keys spread across *distinct* replica sets, the behavior the
+reference's ring-lookup bug (``ClusterConfiguration.java:215``) destroyed.
+"""
+
+import pytest
+
+from mochi_tpu.cluster import ClusterConfig, round_robin_token_assignment
+from mochi_tpu.cluster.config import SHARD_TOKENS
+
+
+def make_config(n=5, rf=4) -> ClusterConfig:
+    return ClusterConfig.build(
+        {f"server-{i}": f"127.0.0.1:{8001 + i}" for i in range(n)}, rf=rf
+    )
+
+
+def test_round_robin_assignment_covers_ring():
+    ids = [f"s{i}" for i in range(4)]
+    assignment = round_robin_token_assignment(ids)
+    all_tokens = sorted(t for tokens in assignment.values() for t in tokens)
+    assert all_tokens == list(range(SHARD_TOKENS))
+    assert all(len(v) == SHARD_TOKENS // 4 for v in assignment.values())
+
+
+def test_quorum_math():
+    cfg = make_config(5, 4)
+    assert cfg.f == 1
+    assert cfg.quorum == 3
+    cfg7 = make_config(7, 7)
+    assert cfg7.f == 2
+    assert cfg7.quorum == 5
+
+
+def test_replica_set_size_and_uniqueness():
+    cfg = make_config(5, 4)
+    for key in [f"key-{i}" for i in range(200)]:
+        rs = cfg.replica_set_for_key(key)
+        assert len(rs) == 4
+        assert len(set(rs)) == 4
+
+
+def test_keys_distribute_across_servers():
+    # ref: ClusterConfigurationTest.java:47-103 — 200 random keys, each server
+    # should serve a healthy share.
+    cfg = make_config(5, 4)
+    counts = {sid: 0 for sid in cfg.servers}
+    for i in range(200):
+        for sid in cfg.replica_set_for_key(f"random-key-{i}"):
+            counts[sid] += 1
+    assert all(c >= 20 for c in counts.values()), counts
+
+
+def test_distinct_replica_sets_exist():
+    # The fixed ring walk must produce >1 distinct replica set (the reference
+    # bug collapsed all keys onto one).
+    cfg = make_config(5, 4)
+    sets = {tuple(sorted(cfg.replica_set_for_key(f"key-{i}"))) for i in range(100)}
+    assert len(sets) > 1
+
+
+def test_config_keys_owned_everywhere():
+    cfg = make_config(5, 4)
+    assert cfg.replica_set_for_key("_CONFIG_epoch") == sorted(cfg.servers)
+    assert cfg.owns_key("server-3", "_CONFIG_epoch")
+
+
+def test_validation_rejects_bad_rf():
+    with pytest.raises(ValueError):
+        make_config(5, rf=3)
+    with pytest.raises(ValueError):
+        make_config(3, rf=4)
+
+
+def test_properties_roundtrip():
+    cfg = make_config(5, 4)
+    cfg.public_keys["server-0"] = b"\x07" * 32
+    parsed = ClusterConfig.from_properties(cfg.to_properties())
+    assert parsed.rf == cfg.rf
+    assert parsed.token_owners == cfg.token_owners
+    assert {s.url for s in parsed.servers.values()} == {s.url for s in cfg.servers.values()}
+    assert parsed.public_keys == {"server-0": b"\x07" * 32}
+
+
+def test_reference_properties_file_parses():
+    # The reference's shipped config loads unmodified (capability parity).
+    with open("/root/reference/config/sample_config") as fh:
+        cfg = ClusterConfig.from_properties(fh.read())
+    assert cfg.n_servers == 5
+    assert cfg.rf == 4
+    assert cfg.quorum == 3
+
+
+def test_json_roundtrip():
+    cfg = make_config(6, 4)
+    parsed = ClusterConfig.from_json(cfg.to_json())
+    assert parsed == cfg
