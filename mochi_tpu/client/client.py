@@ -1,0 +1,260 @@
+"""Transaction-coordinating client (ref: ``client/MochiDBClient.java``).
+
+The client is the only coordinator in the protocol (no server↔server links —
+SURVEY.md §2.9): it fans requests to the replica set, tallies 2f+1 quorums
+per operation, and assembles write certificates from signed MultiGrants.
+
+Differences from the reference, all deliberate:
+
+* every outbound envelope is Ed25519-signed by the client, and server
+  response envelopes are signature-checked before counting toward any quorum
+  (the reference has no message authentication at all);
+* refused Write1s are retried with a fresh seed a bounded number of times
+  before surfacing ``RequestRefused`` (the reference throws immediately,
+  ``MochiDBClient.java:324-328``, pushing retry onto the application);
+* responses are awaited with asyncio timeouts rather than 5 ms busy-poll
+  loops (``Utils.java:65-93``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+import uuid
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..cluster.config import ClusterConfig, ServerInfo
+from ..crypto.keys import KeyPair, generate_keypair, verify as cpu_verify
+from ..net.transport import RpcClientPool, fan_out
+from ..protocol import (
+    Envelope,
+    MultiGrant,
+    Operation,
+    Action,
+    ReadFromServer,
+    ReadToServer,
+    RequestFailedFromServer,
+    Status,
+    Transaction,
+    TransactionResult,
+    Write1OkFromServer,
+    Write1RefusedFromServer,
+    Write1ToServer,
+    Write2AnsFromServer,
+    Write2ToServer,
+    WriteCertificate,
+    transaction_hash,
+)
+from ..utils.metrics import Metrics
+from .errors import InconsistentRead, InconsistentWrite, RequestRefused
+import time
+
+LOG = logging.getLogger(__name__)
+
+SEED_RANGE = 1000  # ref: MochiDBClient.java:262 — seed = rand.nextInt(1000)
+
+
+@dataclass
+class MochiDBClient:
+    """Async client SDK ("MochiSDK", ``mochiDB.tex:96``)."""
+
+    config: ClusterConfig
+    client_id: str = field(default_factory=lambda: f"client-{uuid.uuid4()}")
+    keypair: KeyPair = field(default_factory=generate_keypair)
+    timeout_s: float = 10.0
+    write_attempts: int = 16  # Write1 retry budget (seed collisions + refusals)
+    refusal_retries: int = 8
+    authenticate_servers: bool = True
+
+    def __post_init__(self) -> None:
+        self.pool = RpcClientPool(default_timeout_s=self.timeout_s)
+        self.metrics = Metrics()
+        self._rand = random.Random()
+
+    # ------------------------------------------------------------ plumbing
+
+    def _targets(self, transaction: Transaction) -> List[Tuple[str, ServerInfo]]:
+        """Union of the replica sets of all keys (ref: ``MochiDBClient.java:120-125``)."""
+        seen: Dict[str, ServerInfo] = {}
+        for key in transaction.keys:
+            for info in self.config.servers_for_key(key):
+                seen[info.server_id] = info
+        return sorted(seen.items())
+
+    def _envelope(self, payload, msg_id: str) -> Envelope:
+        env = Envelope(
+            payload=payload,
+            msg_id=msg_id,
+            sender_id=self.client_id,
+            timestamp_ms=int(time.time() * 1000),
+        )
+        return env.with_signature(self.keypair.sign(env.signing_bytes()))
+
+    def _authentic(self, sid: str, env: Envelope) -> bool:
+        if not self.authenticate_servers:
+            return True
+        key = self.config.public_keys.get(sid)
+        if key is None:
+            return True  # unsigned cluster (e.g. unsigned-mode tests)
+        if env.signature is None or env.sender_id != sid:
+            return False
+        return cpu_verify(key, env.signing_bytes(), env.signature)
+
+    async def _fan_out(self, transaction: Transaction, payload_factory) -> Dict[str, object]:
+        """Fan a payload to the replica set; keep only authentic responses."""
+        targets = self._targets(transaction)
+        results = await fan_out(
+            self.pool,
+            targets,
+            lambda msg_id: self._envelope(payload_factory(), msg_id),
+            self.timeout_s,
+        )
+        out: Dict[str, object] = {}
+        for sid, res in results.items():
+            if isinstance(res, Exception):
+                LOG.debug("no response from %s: %s", sid, res)
+                continue
+            if not self._authentic(sid, res):
+                LOG.warning("dropping unauthenticated response claiming to be %s", sid)
+                continue
+            out[sid] = res.payload
+        return out
+
+    async def close(self) -> None:
+        await self.pool.close()
+
+    # ---------------------------------------------------------------- reads
+
+    async def execute_read_transaction(self, transaction: Transaction) -> TransactionResult:
+        """1-round-trip read with per-op 2f+1 agreement
+        (ref: ``executeReadTransactionBL``, ``MochiDBClient.java:114-181``)."""
+        with self.metrics.timer("read-transactions"):
+            nonce = uuid.uuid4().hex
+            with self.metrics.timer("read-transactions-step1-future-wait"):
+                responses = await self._fan_out(
+                    transaction,
+                    lambda: ReadToServer(self.client_id, transaction, nonce),
+                )
+            reads = {
+                sid: p
+                for sid, p in responses.items()
+                if isinstance(p, ReadFromServer) and p.nonce == nonce
+            }
+            n_ops = len(transaction.operations)
+            final: List = []
+            for i in range(n_ops):
+                # Coalesce per-op results, ignoring WRONG_SHARD fillers
+                # (ref: MochiDBClient.java:148-175).
+                tallies: Dict[bytes, Tuple[int, object]] = {}
+                for p in reads.values():
+                    if i >= len(p.result.operations):
+                        continue
+                    op_res = p.result.operations[i]
+                    if op_res.status == Status.WRONG_SHARD:
+                        continue
+                    fp = (bytes(op_res.value or b""), op_res.existed)
+                    count, _ = tallies.get(fp, (0, None))
+                    tallies[fp] = (count + 1, op_res)
+                best = max(tallies.values(), key=lambda t: t[0], default=(0, None))
+                if best[0] < self.config.quorum:
+                    raise InconsistentRead(
+                        f"op {i}: best agreement {best[0]} < quorum {self.config.quorum}"
+                    )
+                final.append(best[1])
+            return TransactionResult(tuple(final))
+
+    # --------------------------------------------------------------- writes
+
+    @staticmethod
+    def _write1_transaction(transaction: Transaction) -> Transaction:
+        """Value-less WRITE ops for every operation — grants are value-blind
+        (ref: ``MochiDBClient.java:256-261``)."""
+        return Transaction(
+            tuple(Operation(Action.WRITE, op.key, None) for op in transaction.operations)
+        )
+
+    @staticmethod
+    def _uniform_timestamps(grants: Sequence[MultiGrant]) -> bool:
+        """All servers must offer the same timestamp per object
+        (ref: ``isUniformTimeStampInMultiGrants``, ``MochiDBClient.java:195-219``)."""
+        per_object: Dict[str, int] = {}
+        for mg in grants:
+            for key, grant in mg.grants.items():
+                if grant.status != Status.OK:
+                    continue
+                if per_object.setdefault(key, grant.timestamp) != grant.timestamp:
+                    return False
+        return True
+
+    async def execute_write_transaction(self, transaction: Transaction) -> TransactionResult:
+        """2-phase write: Write1 grant acquisition → Write2 certificate commit
+        (ref: ``executeWriteTransactionBL``, ``MochiDBClient.java:237-387``)."""
+        with self.metrics.timer("write-transactions"):
+            txn_hash = transaction_hash(transaction)
+            write1_txn = self._write1_transaction(transaction)
+            refusals = 0
+            for attempt in range(self.write_attempts):
+                seed = self._rand.randrange(SEED_RANGE)
+                responses = await self._fan_out(
+                    write1_txn,
+                    lambda: Write1ToServer(self.client_id, write1_txn, seed, txn_hash),
+                )
+                oks: List[MultiGrant] = []
+                refused = False
+                for sid, p in responses.items():
+                    if isinstance(p, Write1OkFromServer) and p.multi_grant.server_id == sid:
+                        oks.append(p.multi_grant)
+                    elif isinstance(p, Write1RefusedFromServer):
+                        refused = True
+                if refused or len(oks) < self.config.quorum:
+                    # Seed collision with another in-flight transaction (or
+                    # missing responses): back off, fresh seed
+                    # (ref: MochiDBClient.java:310-328 — refusal aborted there).
+                    refusals += 1
+                    if refusals > self.refusal_retries:
+                        raise RequestRefused(
+                            f"write refused after {refusals} attempts "
+                            f"({len(oks)} grants, quorum {self.config.quorum})"
+                        )
+                    await asyncio.sleep(0.001 * (1 + attempt))
+                    continue
+                if not self._uniform_timestamps(oks):
+                    # Replicas disagree on epochs (lagging replica):
+                    # ref sleeps 1 ms and retries (MochiDBClient.java:310-318).
+                    await asyncio.sleep(0.001)
+                    continue
+                certificate = WriteCertificate({mg.server_id: mg for mg in oks})
+                return await self._write2(transaction, certificate)
+            raise RequestRefused(f"write did not converge in {self.write_attempts} attempts")
+
+    async def _write2(
+        self, transaction: Transaction, certificate: WriteCertificate
+    ) -> TransactionResult:
+        responses = await self._fan_out(
+            transaction, lambda: Write2ToServer(certificate, transaction)
+        )
+        n_ops = len(transaction.operations)
+        final: List = []
+        for i in range(n_ops):
+            tallies: Dict[Tuple, Tuple[int, object]] = {}
+            for p in responses.values():
+                if not isinstance(p, Write2AnsFromServer):
+                    continue
+                if i >= len(p.result.operations):
+                    continue
+                op_res = p.result.operations[i]
+                if op_res.status == Status.WRONG_SHARD:
+                    continue
+                fp = (bytes(op_res.value or b""), op_res.status)
+                count, _ = tallies.get(fp, (0, None))
+                tallies[fp] = (count + 1, op_res)
+            best = max(tallies.values(), key=lambda t: t[0], default=(0, None))
+            if best[0] < self.config.quorum:
+                # ref: per-op 2f+1 tally (MochiDBClient.java:355-382)
+                raise InconsistentWrite(
+                    f"op {i}: best agreement {best[0]} < quorum {self.config.quorum}"
+                )
+            final.append(best[1])
+        return TransactionResult(tuple(final))

@@ -1,0 +1,21 @@
+"""Client-visible outcome taxonomy (ref: ``client/*Exception.java``)."""
+
+
+class MochiClientError(Exception):
+    """Base class for client-visible transaction failures."""
+
+
+class InconsistentRead(MochiClientError):
+    """No 2f+1 agreeing read responses (ref: ``InconsistentReadException``)."""
+
+
+class InconsistentWrite(MochiClientError):
+    """No 2f+1 agreeing Write2 acks (ref: ``InconsistentWriteException``)."""
+
+
+class RequestFailed(MochiClientError):
+    """Server reported a typed failure (ref: ``RequestFailedException``)."""
+
+
+class RequestRefused(MochiClientError):
+    """Write1 grant refused after retries (ref: ``RequestRefusedException``)."""

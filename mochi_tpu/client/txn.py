@@ -1,0 +1,35 @@
+"""Fluent transaction builder (ref: ``client/TransactionBuilder.java:14-57``)."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..protocol import Action, Operation, Transaction
+
+
+class TransactionBuilder:
+    def __init__(self) -> None:
+        self._ops: List[Operation] = []
+
+    def write(self, key: str, value: bytes | str) -> "TransactionBuilder":
+        if isinstance(value, str):
+            value = value.encode("utf-8")
+        self._ops.append(Operation(Action.WRITE, key, value))
+        return self
+
+    def write_without_value(self, key: str) -> "TransactionBuilder":
+        self._ops.append(Operation(Action.WRITE, key, None))
+        return self
+
+    def read(self, key: str) -> "TransactionBuilder":
+        self._ops.append(Operation(Action.READ, key))
+        return self
+
+    def delete(self, key: str) -> "TransactionBuilder":
+        self._ops.append(Operation(Action.DELETE, key))
+        return self
+
+    def build(self) -> Transaction:
+        if not self._ops:
+            raise ValueError("empty transaction")
+        return Transaction(tuple(self._ops))

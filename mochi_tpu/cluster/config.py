@@ -216,6 +216,8 @@ class ClusterConfig:
             servers[sid] = ServerInfo.from_url(sid, url)
             for tok in props[PROPERTY_SERVER_TOKENS.format(sid)].split(","):
                 token = int(tok)
+                if not 0 <= token < SHARD_TOKENS:
+                    raise ValueError(f"token {token} outside [0, {SHARD_TOKENS})")
                 if token_owners[token]:
                     raise ValueError(f"token {token} assigned twice")
                 token_owners[token] = sid

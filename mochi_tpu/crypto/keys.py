@@ -1,0 +1,63 @@
+"""Host-side Ed25519 signing and verification.
+
+The reference *declares* signatures and never implements them
+(``MochiProtocol.proto:123`` "TODO: add signature"; ``mochiDB.tex:202`` "Our
+implementation lacks PKI support").  Here they are first-class: replicas and
+clients hold Ed25519 keypairs; signing and the default CPU verify path use the
+host ``cryptography`` library (OpenSSL) — the "BouncyCastle analog" of
+BASELINE.json — while the TPU batch-verify path lives in
+:mod:`mochi_tpu.crypto.batch_verify`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from cryptography.exceptions import InvalidSignature
+from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+    Ed25519PrivateKey,
+    Ed25519PublicKey,
+)
+from cryptography.hazmat.primitives.serialization import (
+    Encoding,
+    NoEncryption,
+    PrivateFormat,
+    PublicFormat,
+)
+
+
+@dataclass(frozen=True)
+class KeyPair:
+    """Raw Ed25519 keypair: 32-byte seed + 32-byte compressed public key."""
+
+    private_seed: bytes
+    public_key: bytes
+
+    def sign(self, message: bytes) -> bytes:
+        return sign(self.private_seed, message)
+
+
+def generate_keypair() -> KeyPair:
+    priv = Ed25519PrivateKey.generate()
+    seed = priv.private_bytes(Encoding.Raw, PrivateFormat.Raw, NoEncryption())
+    pub = priv.public_key().public_bytes(Encoding.Raw, PublicFormat.Raw)
+    return KeyPair(seed, pub)
+
+
+def keypair_from_seed(seed: bytes) -> KeyPair:
+    priv = Ed25519PrivateKey.from_private_bytes(seed)
+    pub = priv.public_key().public_bytes(Encoding.Raw, PublicFormat.Raw)
+    return KeyPair(seed, pub)
+
+
+def sign(private_seed: bytes, message: bytes) -> bytes:
+    return Ed25519PrivateKey.from_private_bytes(private_seed).sign(message)
+
+
+def verify(public_key: bytes, message: bytes, signature: bytes) -> bool:
+    """Single-signature CPU verify; returns False on any malformed input."""
+    try:
+        Ed25519PublicKey.from_public_bytes(public_key).verify(signature, message)
+        return True
+    except (InvalidSignature, ValueError):
+        return False

@@ -55,9 +55,13 @@ def _encode_into(buf: bytearray, value: Any, depth: int) -> None:
         buf.append(T_FALSE)
     elif isinstance(value, int):
         if value >= 0:
+            if value >= 1 << 64:
+                raise TypeError(f"mcode int out of range: {value}")
             buf.append(T_UINT)
             _write_varint(buf, value)
         else:
+            if -1 - value >= 1 << 64:
+                raise TypeError(f"mcode int out of range: {value}")
             buf.append(T_NINT)
             _write_varint(buf, -1 - value)
     elif isinstance(value, (bytes, bytearray, memoryview)):
@@ -111,6 +115,8 @@ class _Reader:
             self.pos += 1
             result |= (b & 0x7F) << shift
             if not (b & 0x80):
+                if result >= 1 << 64:
+                    raise ValueError("mcode: varint out of 64-bit range")
                 return result
             shift += 7
             if shift > 63:

@@ -47,6 +47,7 @@ class FailType(IntEnum):
     OLD_REQUEST = 0
     BAD_SIGNATURE = 1  # new: message failed signature verification
     BAD_CERTIFICATE = 2  # new: write certificate failed quorum/signature checks
+    BAD_REQUEST = 3  # new: request failed input validation (e.g. seed range)
 
 
 # --------------------------------------------------------------------------

@@ -1,0 +1,82 @@
+"""Replica server entrypoint.
+
+Ops-layer equivalent of the reference's boot path (``start_mochi.sh:4-8`` →
+``Application.main`` → ``MochiServerInitializator`` → ``MochiServer.start()``,
+SURVEY.md §3.1), as a plain asyncio process instead of a Spring Boot shell.
+
+Usage:
+    python -m mochi_tpu.server --config cluster/cluster_config.json \
+        --server-id server-0 --seed-file cluster/server-0.seed [--verifier cpu|tpu]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+from pathlib import Path
+
+from ..cluster.config import ClusterConfig
+from ..crypto.keys import keypair_from_seed
+from ..server.replica import MochiReplica
+
+
+def load_config(path: str) -> ClusterConfig:
+    text = Path(path).read_text()
+    if text.lstrip().startswith("{"):
+        return ClusterConfig.from_json(text)
+    return ClusterConfig.from_properties(text)
+
+
+async def amain(args) -> None:
+    config = load_config(args.config)
+    keypair = keypair_from_seed(bytes.fromhex(Path(args.seed_file).read_text().strip()))
+    if keypair.public_key != config.public_keys.get(args.server_id):
+        raise SystemExit(
+            f"seed file does not match configured public key for {args.server_id}"
+        )
+    info = config.servers[args.server_id]
+    verifier = None
+    if args.verifier == "tpu":
+        try:
+            from ..verifier.tpu import TpuBatchVerifier
+        except ImportError as exc:
+            raise SystemExit(f"TPU verifier unavailable ({exc}); use --verifier cpu") from exc
+        verifier = TpuBatchVerifier()
+    replica = MochiReplica(
+        server_id=args.server_id,
+        config=config,
+        keypair=keypair,
+        verifier=verifier,
+        host=args.host or info.host,
+        port=info.port,
+    )
+    await replica.start()
+    logging.info("replica %s serving on %s:%s", args.server_id, replica.rpc.host, replica.bound_port)
+    print(f"READY {args.server_id} {replica.bound_port}", flush=True)
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await replica.close()
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--config", required=True)
+    parser.add_argument("--server-id", required=True)
+    parser.add_argument("--seed-file", required=True)
+    parser.add_argument("--host", default=None, help="bind host override (e.g. 0.0.0.0)")
+    parser.add_argument("--verifier", choices=("cpu", "tpu"), default="cpu")
+    parser.add_argument("--log-level", default="INFO")
+    args = parser.parse_args(argv)
+    logging.basicConfig(
+        level=args.log_level, format="%(asctime)s %(name)s %(levelname)s %(message)s"
+    )
+    try:
+        asyncio.run(amain(args))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,195 @@
+"""Replica runtime: dispatch, message authentication, certificate verification.
+
+Combines the reference's L4/L5 (``RequestHandlerDispatcher.java:44-61`` typed
+dispatch; ``MochiServer.java`` runtime) with the new signature pipeline at
+exactly the seam SURVEY.md §2.4 identifies: message ingress, *before* the
+datastore.  Flow per inbound envelope:
+
+1. authenticate the sender's envelope signature (servers' keys from the
+   cluster config; clients' keys from a registry) via the
+   ``SignatureVerifier`` SPI — forged envelopes get ``BAD_SIGNATURE``;
+2. for Write2: verify every MultiGrant signature in the certificate (the
+   2f+1 quorum-cert check, batched on the verifier — the hot path of
+   BASELINE.json configs 3-4), dropping invalid grants *before* the
+   datastore's quorum count;
+3. dispatch to the datastore state machine;
+4. sign MultiGrants we issue and the response envelope.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+import uuid
+from dataclasses import replace
+from typing import Dict, Optional
+
+from ..cluster.config import ClusterConfig
+from ..crypto.keys import KeyPair
+from ..net.transport import RpcServer
+from ..protocol import (
+    Envelope,
+    FailType,
+    HelloFromServer,
+    HelloToServer,
+    ReadFromServer,
+    ReadToServer,
+    RequestFailedFromServer,
+    Write1OkFromServer,
+    Write1RefusedFromServer,
+    Write1ToServer,
+    Write2ToServer,
+    WriteCertificate,
+)
+from ..utils.metrics import Metrics
+from ..verifier.spi import CpuVerifier, SignatureVerifier, VerifyItem
+from .store import BadRequest, DataStore
+
+LOG = logging.getLogger(__name__)
+
+
+class MochiReplica:
+    """One BFT replica node (ref: ``MochiServer.java`` + handler set)."""
+
+    def __init__(
+        self,
+        server_id: str,
+        config: ClusterConfig,
+        keypair: KeyPair,
+        verifier: Optional[SignatureVerifier] = None,
+        client_public_keys: Optional[Dict[str, bytes]] = None,
+        require_client_auth: bool = False,
+        host: str = "0.0.0.0",
+        port: int = 8081,  # ref default port: MochiServer.java:33-34
+    ):
+        self.server_id = server_id
+        self.config = config
+        self.keypair = keypair
+        self.verifier = verifier if verifier is not None else CpuVerifier()
+        self.client_public_keys = client_public_keys if client_public_keys is not None else {}
+        self.require_client_auth = require_client_auth
+        self.store = DataStore(server_id, config)
+        self.rpc = RpcServer(host, port, self.handle_envelope)
+        self.metrics = Metrics()
+
+    # ----------------------------------------------------------------- boot
+
+    async def start(self) -> None:
+        await self.rpc.start()
+
+    async def close(self) -> None:
+        await self.rpc.close()
+
+    @property
+    def bound_port(self) -> int:
+        return self.rpc.bound_port
+
+    # ------------------------------------------------------------- envelopes
+
+    def _sender_key(self, sender_id: str) -> Optional[bytes]:
+        key = self.config.public_keys.get(sender_id)
+        if key is None:
+            key = self.client_public_keys.get(sender_id)
+        return key
+
+    async def _authenticate(self, env: Envelope) -> bool:
+        key = self._sender_key(env.sender_id)
+        if key is None:
+            # Unknown sender: only acceptable in open (non-auth-required) mode.
+            return not self.require_client_auth
+        if env.signature is None:
+            # Known identity but stripped signature: always an impersonation
+            # attempt — reject regardless of auth mode.
+            return False
+        with self.metrics.timer("replica.auth-verify"):
+            (ok,) = await self.verifier.verify_batch(
+                [VerifyItem(key, env.signing_bytes(), env.signature)]
+            )
+        return ok
+
+    def _respond(self, env: Envelope, payload) -> Envelope:
+        response = Envelope(
+            payload=payload,
+            msg_id=uuid.uuid4().hex,
+            sender_id=self.server_id,
+            reply_to=env.msg_id,
+            timestamp_ms=int(time.time() * 1000),
+        )
+        return response.with_signature(self.keypair.sign(response.signing_bytes()))
+
+    async def handle_envelope(self, env: Envelope) -> Optional[Envelope]:
+        """Typed dispatch (ref: ``RequestHandlerDispatcher.java:44-61``)."""
+        if not await self._authenticate(env):
+            self.metrics.mark("replica.bad-signature")
+            return self._respond(
+                env, RequestFailedFromServer(FailType.BAD_SIGNATURE, "envelope signature invalid")
+            )
+        payload = env.payload
+        if isinstance(payload, HelloToServer):
+            return self._respond(env, HelloFromServer(f"{payload.message} back"))
+        if isinstance(payload, ReadToServer):
+            with self.metrics.timer("replica.read"):
+                result = self.store.process_read(payload.transaction)
+            return self._respond(
+                env, ReadFromServer(result, payload.nonce, rid=uuid.uuid4().hex)
+            )
+        if isinstance(payload, Write1ToServer):
+            with self.metrics.timer("replica.write1"):
+                try:
+                    response = self.store.process_write1(payload)
+                except BadRequest as exc:
+                    return self._respond(
+                        env, RequestFailedFromServer(FailType.BAD_REQUEST, str(exc))
+                    )
+            mg = response.multi_grant
+            response = replace(
+                response,
+                multi_grant=mg.with_signature(self.keypair.sign(mg.signing_bytes())),
+            )
+            return self._respond(env, response)
+        if isinstance(payload, Write2ToServer):
+            with self.metrics.timer("replica.write2"):
+                checked = await self._check_certificate(payload.write_certificate)
+                if checked is None:
+                    self.metrics.mark("replica.bad-certificate")
+                    return self._respond(
+                        env,
+                        RequestFailedFromServer(
+                            FailType.BAD_CERTIFICATE, "certificate signature check failed"
+                        ),
+                    )
+                result = self.store.process_write2(replace(payload, write_certificate=checked))
+            return self._respond(env, result)
+        LOG.warning("unhandled payload type %s", type(payload).__name__)
+        return self._respond(
+            env, RequestFailedFromServer(FailType.OLD_REQUEST, "unhandled payload")
+        )
+
+    async def _check_certificate(self, wc: WriteCertificate) -> Optional[WriteCertificate]:
+        """Verify every MultiGrant signature in a write certificate; drop
+        invalid or unattributable grants.  Returns None if *nothing* checks
+        out (the datastore's quorum count then rejects thin certificates).
+
+        This is the quorum-cert aggregation hot path: 2f+1 signature checks
+        per Write2, batched into one verifier call.
+        """
+        server_ids = list(wc.grants.keys())
+        items = []
+        for sid in server_ids:
+            mg = wc.grants[sid]
+            key = self.config.public_keys.get(sid)
+            if key is None or mg.signature is None or mg.server_id != sid:
+                items.append(None)
+                continue
+            items.append(VerifyItem(key, mg.signing_bytes(), mg.signature))
+        real = [(i, it) for i, it in enumerate(items) if it is not None]
+        bitmap = await self.verifier.verify_batch([it for _, it in real]) if real else []
+        valid = [False] * len(server_ids)
+        for (i, _), ok in zip(real, bitmap):
+            valid[i] = ok
+        kept = {sid: wc.grants[sid] for sid, ok in zip(server_ids, valid) if ok}
+        if len(kept) != len(server_ids):
+            self.metrics.mark("replica.dropped-grants", len(server_ids) - len(kept))
+        if not kept:
+            return None
+        return WriteCertificate(kept)

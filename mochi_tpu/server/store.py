@@ -1,0 +1,341 @@
+"""Replica datastore: the quorum-BFT protocol state machine.
+
+Re-implements the semantics of the reference's
+``server/datastrore/InMemoryDataStore.java`` + ``StoreValueObjectContainer.java``
+(SVOC) in a single-threaded, asyncio-friendly form: the reference guards every
+object with a ``ReentrantReadWriteLock`` and sorted lock acquisition
+(``InMemoryDataStore.java:333-335``); here every datastore call runs to
+completion on the replica's event loop, so the whole transaction is naturally
+atomic with no locks and no deadlock ordering.
+
+Protocol semantics preserved (with reference cites):
+
+* Write1 grant issuance at ``prospective_ts = current_epoch + seed``; existing
+  grant at that ts → idempotent return on matching transaction hash, refusal
+  otherwise (``InMemoryDataStore.java:105-155``).
+* Write2: coalesce per-object grants across servers, requiring equal
+  timestamps (``:613-640``); quorum ``>= 2f+1`` (fixing the strict ``>``
+  off-by-one at ``:590``); per-object transaction-hash check (``:580,591``,
+  returning a typed BAD_CERTIFICATE failure instead of the reference's
+  ``UnsupportedOperationException`` TODO at ``:601-607``); stale-timestamp
+  objects are read back instead of written (``:594-598``).
+* Apply: store certificate, consume the grant, advance the epoch, set/clear
+  value (``:521-554``; ``StoreValueObjectContainer.java:83-88,146-156``).
+* Grant GC: the reference defines ``truncateGivenWrite1Grants`` but never
+  calls it (``StoreValueObjectContainer.java:158-169``); here it runs on every
+  epoch advance.
+* ``_CONFIG_``-prefixed keys live in a separate, always-locally-owned keyspace
+  (``InMemoryDataStore.java:44,56-73``).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field as dc_field
+from typing import Dict, List, Optional, Tuple, Union
+
+from ..cluster.config import CONFIG_KEY_PREFIX, ClusterConfig
+from ..protocol import (
+    Action,
+    FailType,
+    Grant,
+    MultiGrant,
+    Operation,
+    OperationResult,
+    RequestFailedFromServer,
+    Status,
+    Transaction,
+    TransactionResult,
+    Write1OkFromServer,
+    Write1RefusedFromServer,
+    Write1ToServer,
+    Write2AnsFromServer,
+    Write2ToServer,
+    WriteCertificate,
+    transaction_hash,
+)
+
+LOG = logging.getLogger(__name__)
+
+# Epoch granularity: seeds are drawn from [0, EPOCH_UNIT) and prospective
+# timestamps are epoch+seed (ref: StoreValueObjectContainer.java:83-88,
+# MochiDBClient.java:262).
+EPOCH_UNIT = 1000
+# Grant-book GC horizon: epochs this far behind current are dropped
+# (ref: StoreValueObjectContainer.java:158-169).
+GRANT_GC_EPOCHS = 2 * EPOCH_UNIT
+
+
+@dataclass
+class StoreValue:
+    """Per-object container (ref: ``StoreValueObjectContainer.java:24-53``)."""
+
+    key: str
+    value: Optional[bytes] = None
+    exists: bool = False
+    current_certificate: Optional[WriteCertificate] = None
+    # epoch -> timestamp -> Grant (ref: givenWrite1Grants, SVOC.java:38-40)
+    grants: Dict[int, Dict[int, Grant]] = dc_field(default_factory=dict)
+    current_epoch: int = 0
+
+    @staticmethod
+    def epoch_of(ts: int) -> int:
+        return (ts // EPOCH_UNIT) * EPOCH_UNIT
+
+    def grant_at(self, ts: int) -> Optional[Grant]:
+        return self.grants.get(self.epoch_of(ts), {}).get(ts)
+
+    def add_grant(self, grant: Grant) -> None:
+        self.grants.setdefault(self.epoch_of(grant.timestamp), {})[grant.timestamp] = grant
+
+    def delete_grant(self, ts: int) -> None:
+        epoch = self.epoch_of(ts)
+        bucket = self.grants.get(epoch)
+        if bucket is not None:
+            bucket.pop(ts, None)
+            if not bucket:
+                del self.grants[epoch]
+
+    def advance_epoch(self, applied_ts: int) -> None:
+        """Move past the applied timestamp's epoch and GC stale grant epochs
+        (ref: ``moveToNextEpochIfNecessary``, SVOC.java:83-88 — plus the GC the
+        reference never wired up, SVOC.java:158-169)."""
+        nxt = self.epoch_of(applied_ts) + EPOCH_UNIT
+        if nxt > self.current_epoch:
+            self.current_epoch = nxt
+        horizon = self.current_epoch - GRANT_GC_EPOCHS
+        for epoch in [e for e in self.grants if e < horizon]:
+            del self.grants[epoch]
+
+    def certificate_timestamp(self) -> Optional[int]:
+        """Timestamp agreed by the current certificate's grants for this key
+        (ref: ``getCurrentTimestampFromCurrentCertificate``, SVOC.java:175-198)."""
+        if self.current_certificate is None:
+            return None
+        ts: Optional[int] = None
+        for mg in self.current_certificate.grants.values():
+            grant = mg.grants.get(self.key)
+            if grant is None:
+                continue
+            if ts is None:
+                ts = grant.timestamp
+            elif ts != grant.timestamp:
+                raise ValueError(f"certificate timestamps disagree for {self.key}")
+        return ts
+
+
+Write1Response = Union[Write1OkFromServer, Write1RefusedFromServer]
+Write2Response = Union[Write2AnsFromServer, RequestFailedFromServer]
+
+
+class DataStore:
+    """The protocol state machine for one replica.
+
+    Synchronous and lock-free by design; the surrounding replica runtime
+    serializes calls on its event loop.  Signature verification happens
+    *before* these entry points (the ``SignatureVerifier`` seam — SURVEY.md
+    §2.4); the store trusts its inputs' signatures but still enforces quorum
+    shape, hash agreement and timestamp agreement.
+    """
+
+    def __init__(self, server_id: str, config: ClusterConfig):
+        self.server_id = server_id
+        self.config = config
+        self.data: Dict[str, StoreValue] = {}
+        self.data_config: Dict[str, StoreValue] = {}  # _CONFIG_ keyspace
+
+    # ------------------------------------------------------------------ util
+
+    def _map_for(self, key: str) -> Dict[str, StoreValue]:
+        return self.data_config if key.startswith(CONFIG_KEY_PREFIX) else self.data
+
+    def _get(self, key: str) -> Optional[StoreValue]:
+        return self._map_for(key).get(key)
+
+    def _get_or_create(self, key: str) -> StoreValue:
+        m = self._map_for(key)
+        sv = m.get(key)
+        if sv is None:
+            sv = StoreValue(key)
+            m[key] = sv
+        return sv
+
+    def owns(self, key: str) -> bool:
+        return self.config.owns_key(self.server_id, key)
+
+    # ------------------------------------------------------------------ read
+
+    def process_read(self, transaction: Transaction) -> TransactionResult:
+        """1-round-trip read (ref: ``processReadRequest``,
+        ``InMemoryDataStore.java:200-231,75-103``)."""
+        results: List[OperationResult] = []
+        for op in transaction.operations:
+            if not self.owns(op.key):
+                results.append(OperationResult(status=Status.WRONG_SHARD))
+                continue
+            sv = self._get(op.key)
+            if sv is None:
+                results.append(OperationResult(None, None, False, Status.OK))
+            else:
+                results.append(
+                    OperationResult(sv.value, sv.current_certificate, sv.exists, Status.OK)
+                )
+        return TransactionResult(tuple(results))
+
+    # ---------------------------------------------------------------- write1
+
+    def process_write1(self, req: Write1ToServer) -> Write1Response:
+        """Issue (or refuse) grants for every key in the transaction
+        (ref: ``tryProcessWriteRegularly``, ``InMemoryDataStore.java:233-310``)."""
+        if not 0 <= req.seed < EPOCH_UNIT:
+            # A Byzantine client must not steer prospective timestamps into
+            # arbitrary epochs (epoch-jump / grant-GC attacks).
+            raise BadRequest(f"seed {req.seed} outside [0, {EPOCH_UNIT})")
+        grants: Dict[str, Grant] = {}
+        current_certs: Dict[str, WriteCertificate] = {}
+        all_ok = True
+        for op in req.transaction.operations:
+            if not op.key:
+                raise BadRequest("empty key in operation")
+            if op.key in grants:  # one grant per object per txn
+                continue
+            if not self.owns(op.key):
+                grants[op.key] = Grant(
+                    op.key, 0, self.config.configstamp, req.transaction_hash, Status.WRONG_SHARD
+                )
+                continue
+            sv = self._get_or_create(op.key)
+            prospective_ts = sv.current_epoch + req.seed
+            existing = sv.grant_at(prospective_ts)
+            if existing is None:
+                grant = Grant(
+                    op.key, prospective_ts, self.config.configstamp, req.transaction_hash, Status.OK
+                )
+                sv.add_grant(grant)
+                grants[op.key] = grant
+            elif existing.transaction_hash == req.transaction_hash:
+                # Idempotent retry (ref: InMemoryDataStore.java:141-148)
+                grants[op.key] = existing
+            else:
+                # Timestamp taken by a different transaction → refuse, return
+                # the conflicting state (ref: InMemoryDataStore.java:149-154)
+                grants[op.key] = Grant(
+                    op.key, prospective_ts, self.config.configstamp, req.transaction_hash, Status.REFUSED
+                )
+                all_ok = False
+            if sv.current_certificate is not None:
+                current_certs[op.key] = sv.current_certificate
+        multi_grant = MultiGrant(grants=grants, client_id=req.client_id, server_id=self.server_id)
+        if all_ok:
+            return Write1OkFromServer(multi_grant, current_certs)
+        return Write1RefusedFromServer(multi_grant, current_certs, req.client_id)
+
+    # ---------------------------------------------------------------- write2
+
+    def _coalesce_grants(
+        self, wc: WriteCertificate, transaction: Transaction
+    ) -> Dict[str, Tuple[int, List[Grant]]]:
+        """Group certificate grants per object; timestamps must agree across
+        servers (ref: ``processMultiGrantsFromAllServers``,
+        ``InMemoryDataStore.java:613-640``).
+
+        Only grants from servers *inside the object's replica set* count:
+        the BFT fault assumption (at most f faulty of the 3f+1 replicas of a
+        set) says nothing about servers outside the set, so a grant from an
+        out-of-set server — however validly signed — must not contribute to
+        the quorum.
+        """
+        coalesced: Dict[str, Tuple[int, List[Grant]]] = {}
+        replica_sets = {op.key: set(self.config.replica_set_for_key(op.key)) for op in transaction.operations}
+        for mg in wc.grants.values():
+            for op in transaction.operations:
+                grant = mg.grants.get(op.key)
+                if grant is None or grant.status != Status.OK:
+                    continue
+                if mg.server_id not in replica_sets[op.key]:
+                    continue
+                entry = coalesced.get(op.key)
+                if entry is None:
+                    coalesced[op.key] = (grant.timestamp, [grant])
+                elif entry[0] != grant.timestamp:
+                    raise BadCertificate(f"grant timestamps disagree for {op.key}")
+                else:
+                    entry[1].append(grant)
+        return coalesced
+
+    def process_write2(self, req: Write2ToServer) -> Write2Response:
+        """Verify certificate shape and apply the transaction
+        (ref: ``processWrite2ToServer`` + ``write2apply``,
+        ``InMemoryDataStore.java:576-611,641-666``)."""
+        transaction = req.transaction
+        txn_hash = transaction_hash(transaction)
+        try:
+            coalesced = self._coalesce_grants(req.write_certificate, transaction)
+        except BadCertificate as exc:
+            return RequestFailedFromServer(FailType.BAD_CERTIFICATE, str(exc))
+
+        results: List[OperationResult] = []
+        applied: Dict[str, OperationResult] = {}
+        for op in transaction.operations:
+            if not self.owns(op.key):
+                results.append(OperationResult(status=Status.WRONG_SHARD))
+                continue
+            if op.key in applied:
+                results.append(applied[op.key])
+                continue
+            entry = coalesced.get(op.key)
+            if entry is None:
+                return RequestFailedFromServer(
+                    FailType.BAD_CERTIFICATE, f"no grants for {op.key}"
+                )
+            ts, grant_list = entry
+            # Quorum: >= 2f+1 distinct-server grants (fixes the strict-'>' at
+            # InMemoryDataStore.java:590).
+            if len(grant_list) < self.config.quorum:
+                return RequestFailedFromServer(
+                    FailType.BAD_CERTIFICATE,
+                    f"{len(grant_list)} grants < quorum {self.config.quorum} for {op.key}",
+                )
+            if any(g.transaction_hash != txn_hash for g in grant_list):
+                return RequestFailedFromServer(
+                    FailType.BAD_CERTIFICATE, f"transaction hash mismatch for {op.key}"
+                )
+            sv = self._get_or_create(op.key)
+            current_ts = sv.certificate_timestamp()
+            if current_ts is not None and current_ts > ts:
+                # Stale write2: answer with current state instead
+                # (ref: InMemoryDataStore.java:594-598).
+                result = OperationResult(sv.value, sv.current_certificate, sv.exists, Status.OK)
+            else:
+                result = self._apply(op, sv, ts, req.write_certificate)
+            applied[op.key] = result
+            results.append(result)
+        return Write2AnsFromServer(TransactionResult(tuple(results)), rid="")
+
+    def _apply(
+        self, op: Operation, sv: StoreValue, ts: int, wc: WriteCertificate
+    ) -> OperationResult:
+        """Commit one operation (ref: ``applyOperation``,
+        ``InMemoryDataStore.java:521-554``)."""
+        if op.action not in (Action.WRITE, Action.DELETE):
+            # READ inside a write transaction: serve current state.
+            return OperationResult(sv.value, sv.current_certificate, sv.exists, Status.OK)
+        existed_before = sv.exists
+        sv.current_certificate = wc
+        sv.delete_grant(ts)
+        sv.advance_epoch(ts)
+        if op.action == Action.WRITE:
+            sv.value = op.value
+            sv.exists = True
+        else:
+            sv.value = None
+            sv.exists = False
+        return OperationResult(op.value, wc, existed_before, Status.OK)
+
+
+class BadCertificate(Exception):
+    """Certificate failed structural checks (timestamp disagreement etc.)."""
+
+
+class BadRequest(Exception):
+    """Request failed input validation (out-of-range seed, empty key, ...)."""

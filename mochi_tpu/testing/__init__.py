@@ -1,0 +1,3 @@
+from .virtual_cluster import VirtualCluster
+
+__all__ = ["VirtualCluster"]

@@ -1,0 +1,60 @@
+"""Generate a cluster config + per-server Ed25519 key seeds.
+
+Ops-layer equivalent of the reference's bootstrap path (``start_mochi.sh`` +
+``putTokensAroundRingProps``, ``ClusterConfiguration.java:85-116``), extended
+with the key material the reference never had.
+
+Usage:
+    python -m mochi_tpu.tools.gen_cluster --out-dir cluster/ \
+        --servers 5 --rf 4 --base-port 8001 [--host 127.0.0.1] [--format json]
+
+Writes ``<out-dir>/cluster_config.{json,properties}`` and one
+``<out-dir>/<server-id>.seed`` (hex, 0600) per server.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from pathlib import Path
+
+from ..cluster.config import ClusterConfig
+from ..crypto.keys import generate_keypair
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--out-dir", required=True)
+    parser.add_argument("--servers", type=int, default=5)
+    parser.add_argument("--rf", type=int, default=4)
+    parser.add_argument("--base-port", type=int, default=8001)
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--format", choices=("json", "properties"), default="json")
+    args = parser.parse_args(argv)
+
+    out = Path(args.out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+
+    server_ids = [f"server-{i}" for i in range(args.servers)]
+    keypairs = {sid: generate_keypair() for sid in server_ids}
+    config = ClusterConfig.build(
+        {sid: f"{args.host}:{args.base_port + i}" for i, sid in enumerate(server_ids)},
+        rf=args.rf,
+        public_keys={sid: kp.public_key for sid, kp in keypairs.items()},
+    )
+
+    if args.format == "json":
+        path = out / "cluster_config.json"
+        path.write_text(config.to_json())
+    else:
+        path = out / "cluster_config.properties"
+        path.write_text(config.to_properties())
+    for sid, kp in keypairs.items():
+        seed_path = out / f"{sid}.seed"
+        seed_path.write_text(kp.private_seed.hex())
+        os.chmod(seed_path, 0o600)
+    print(f"wrote {path} and {len(server_ids)} key seeds to {out}/")
+
+
+if __name__ == "__main__":
+    main()

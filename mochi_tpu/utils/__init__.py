@@ -1,0 +1,3 @@
+from .metrics import Metrics, Timer
+
+__all__ = ["Metrics", "Timer"]

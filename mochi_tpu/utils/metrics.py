@@ -1,0 +1,87 @@
+"""Lightweight latency timers and counters.
+
+Capability parity with the reference's Dropwizard ``MetricRegistry`` +
+``JmxReporter`` (``MochiDBClient.java:52-70``: timers ``read-transactions``,
+``read-transactions-step1-future-wait``, ``write-transactions``), kept
+in-process with percentile snapshots instead of JMX.  The reference has no
+server-side metrics (SURVEY.md §5); here replicas carry the same registry.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from collections import defaultdict, deque
+from contextlib import contextmanager
+from typing import Dict, Iterator, List
+
+
+class Timer:
+    """Records durations (seconds); reports count/mean/percentiles.
+
+    Memory-bounded: keeps a sliding window of the most recent
+    ``window`` samples for percentiles (the Dropwizard reservoir analog)
+    plus exact lifetime count/sum for mean and throughput.
+    """
+
+    __slots__ = ("samples", "total_count", "total_seconds", "window")
+
+    def __init__(self, window: int = 8192) -> None:
+        self.window = window
+        self.samples: deque = deque(maxlen=window)
+        self.total_count = 0
+        self.total_seconds = 0.0
+
+    def record(self, seconds: float) -> None:
+        self.samples.append(seconds)
+        self.total_count += 1
+        self.total_seconds += seconds
+
+    def percentile(self, q: float) -> float:
+        if not self.samples:
+            return math.nan
+        data = sorted(self.samples)
+        idx = min(len(data) - 1, max(0, int(round(q / 100.0 * (len(data) - 1)))))
+        return data[idx]
+
+    @property
+    def count(self) -> int:
+        return self.total_count
+
+    @property
+    def mean(self) -> float:
+        return self.total_seconds / self.total_count if self.total_count else math.nan
+
+    def snapshot(self) -> Dict[str, float]:
+        return {
+            "count": self.count,
+            "mean_ms": self.mean * 1e3,
+            "p50_ms": self.percentile(50) * 1e3,
+            "p95_ms": self.percentile(95) * 1e3,
+            "p99_ms": self.percentile(99) * 1e3,
+        }
+
+
+class Metrics:
+    """Registry of named timers and counters."""
+
+    def __init__(self) -> None:
+        self.timers: Dict[str, Timer] = defaultdict(Timer)
+        self.counters: Dict[str, int] = defaultdict(int)
+
+    @contextmanager
+    def timer(self, name: str) -> Iterator[None]:
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.timers[name].record(time.perf_counter() - start)
+
+    def mark(self, name: str, n: int = 1) -> None:
+        self.counters[name] += n
+
+    def snapshot(self) -> Dict[str, Dict]:
+        return {
+            "timers": {name: t.snapshot() for name, t in self.timers.items()},
+            "counters": dict(self.counters),
+        }

@@ -1,0 +1,153 @@
+"""The ``SignatureVerifier`` SPI — the north-star seam (BASELINE.json).
+
+In the reference, message ingress goes straight from the dispatcher to the
+datastore with zero cryptographic verification (``server/requesthandlers/*``,
+SURVEY.md §2.4).  Here every replica routes signature checks through this SPI:
+
+* :class:`CpuVerifier` — the default host path (OpenSSL via ``cryptography``),
+  one verify per call, run inline.
+* :class:`BatchingVerifier` — an async micro-batching front: concurrent
+  requests' signatures accumulate in a queue that flushes to a pluggable
+  batch backend either when ``max_batch`` is reached or after
+  ``max_delay_s`` (bounding p50 commit latency at low load — SURVEY.md §7
+  "batching discipline").  The TPU backend
+  (:func:`mochi_tpu.crypto.batch_verify.verify_batch`) plugs in here; on
+  backend failure it falls back to the CPU path rather than ever skipping
+  verification.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from ..crypto import keys as crypto_keys
+
+LOG = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class VerifyItem:
+    """One Ed25519 verification: (public key, message, signature)."""
+
+    public_key: bytes  # 32 bytes
+    message: bytes
+    signature: bytes  # 64 bytes
+
+
+class SignatureVerifier:
+    """SPI: verify a batch, returning a validity bitmap (one bool per item)."""
+
+    async def verify_batch(self, items: Sequence[VerifyItem]) -> List[bool]:
+        raise NotImplementedError
+
+    async def close(self) -> None:
+        pass
+
+
+class CpuVerifier(SignatureVerifier):
+    """Inline host verification (the reference-analog CPU path)."""
+
+    async def verify_batch(self, items: Sequence[VerifyItem]) -> List[bool]:
+        return [
+            crypto_keys.verify(it.public_key, it.message, it.signature) for it in items
+        ]
+
+
+BatchBackend = Callable[[Sequence[VerifyItem]], Sequence[bool]]
+
+
+class BatchingVerifier(SignatureVerifier):
+    """Micro-batching front for a (possibly device-backed) batch backend.
+
+    Requests enqueue items and await their bitmap slice; a single flusher task
+    drains the queue in backend-sized batches.  ``max_delay_s`` bounds how
+    long a lone item waits for co-batching (latency/throughput knob); the
+    flush runs in a thread executor so the event loop keeps serving traffic
+    while the device crunches.
+    """
+
+    def __init__(
+        self,
+        backend: BatchBackend,
+        max_batch: int = 4096,
+        max_delay_s: float = 0.002,
+        fallback: Optional[SignatureVerifier] = None,
+    ):
+        self.backend = backend
+        self.max_batch = max_batch
+        self.max_delay_s = max_delay_s
+        self.fallback = fallback if fallback is not None else CpuVerifier()
+        self._pending: List[Tuple[VerifyItem, asyncio.Future]] = []
+        self._wakeup: Optional[asyncio.Event] = None
+        self._flusher: Optional[asyncio.Task] = None
+        self._closed = False
+        # simple counters for observability (see mochi_tpu.utils.metrics)
+        self.batches_flushed = 0
+        self.items_verified = 0
+
+    def _ensure_flusher(self) -> None:
+        if self._flusher is None or self._flusher.done():
+            self._wakeup = asyncio.Event()
+            self._flusher = asyncio.get_running_loop().create_task(self._flush_loop())
+
+    async def verify_batch(self, items: Sequence[VerifyItem]) -> List[bool]:
+        if self._closed:
+            raise RuntimeError("verifier closed")
+        if not items:
+            return []
+        self._ensure_flusher()
+        loop = asyncio.get_running_loop()
+        futures = [loop.create_future() for _ in items]
+        self._pending.extend(zip(items, futures))
+        assert self._wakeup is not None
+        self._wakeup.set()
+        return list(await asyncio.gather(*futures))
+
+    async def _flush_loop(self) -> None:
+        assert self._wakeup is not None
+        while not self._closed:
+            await self._wakeup.wait()
+            self._wakeup.clear()
+            if not self._pending:
+                continue
+            # Micro-batching window: let concurrent requests pile on.
+            if len(self._pending) < self.max_batch and self.max_delay_s > 0:
+                await asyncio.sleep(self.max_delay_s)
+            while self._pending:
+                chunk = self._pending[: self.max_batch]
+                del self._pending[: len(chunk)]
+                await self._run_chunk(chunk)
+
+    async def _run_chunk(self, chunk: List[Tuple[VerifyItem, asyncio.Future]]) -> None:
+        items = [it for it, _ in chunk]
+        loop = asyncio.get_running_loop()
+        try:
+            bitmap = await loop.run_in_executor(None, lambda: list(self.backend(items)))
+            if len(bitmap) != len(items):
+                raise ValueError("backend bitmap length mismatch")
+        except Exception:
+            LOG.exception("batch backend failed; falling back to CPU verify")
+            bitmap = await self.fallback.verify_batch(items)
+        self.batches_flushed += 1
+        self.items_verified += len(items)
+        for (_, fut), ok in zip(chunk, bitmap):
+            if not fut.done():
+                fut.set_result(bool(ok))
+
+    async def close(self) -> None:
+        self._closed = True
+        if self._wakeup is not None:
+            self._wakeup.set()
+        if self._flusher is not None:
+            self._flusher.cancel()
+            try:
+                await self._flusher
+            except (asyncio.CancelledError, Exception):
+                pass
+        for _, fut in self._pending:
+            if not fut.done():
+                fut.cancel()
+        self._pending.clear()

@@ -1,0 +1,180 @@
+"""Byzantine-behavior tests — the adversarial coverage the reference lacks
+(SURVEY.md §4 "Gaps": no equivocating server, no forged certificate tests).
+
+These become possible exactly because signatures exist: forged MultiGrants,
+tampered envelopes, and replayed certificates must be rejected by the
+verifier seam, and honest quorums must still make progress with f Byzantine
+grant sources in the mix.
+"""
+
+import asyncio
+from dataclasses import replace
+
+from mochi_tpu.client import TransactionBuilder
+from mochi_tpu.crypto import generate_keypair
+from mochi_tpu.protocol import (
+    Envelope,
+    FailType,
+    HelloToServer,
+    MultiGrant,
+    RequestFailedFromServer,
+    Write1OkFromServer,
+    Write1ToServer,
+    Write2AnsFromServer,
+    Write2ToServer,
+    WriteCertificate,
+    transaction_hash,
+)
+from mochi_tpu.testing import VirtualCluster
+
+
+def run(coro):
+    asyncio.run(asyncio.wait_for(coro, timeout=60))
+
+
+async def write1_via_wire(vc, client, txn, seed=77):
+    """Collect signed MultiGrants from every replica over the wire."""
+    blind = client._write1_transaction(txn)
+    grants = {}
+    for sid, info in sorted(vc.config.servers.items()):
+        env = client._envelope(
+            Write1ToServer(client.client_id, blind, seed, transaction_hash(txn)), f"w1-{sid}"
+        )
+        resp = await client.pool.send_and_receive(info, env)
+        assert isinstance(resp.payload, Write1OkFromServer)
+        grants[sid] = resp.payload.multi_grant
+    return grants
+
+
+def test_forged_multigrant_dropped_but_honest_quorum_commits():
+    async def main():
+        async with VirtualCluster(4, rf=4) as vc:
+            client = vc.client()
+            txn = TransactionBuilder().write("k", b"honest").build()
+            grants = await write1_via_wire(vc, client, txn)
+
+            # Attacker replaces one server's grant with a forgery "signed" by
+            # a key the attacker controls.
+            attacker = generate_keypair()
+            victim = "server-1"
+            forged = replace(grants[victim], signature=None)
+            forged = forged.with_signature(attacker.sign(forged.signing_bytes()))
+            wc = WriteCertificate({**grants, victim: forged})
+
+            env = client._envelope(Write2ToServer(wc, txn), "w2-forged")
+            resp = await client.pool.send_and_receive(
+                vc.config.servers["server-0"], env
+            )
+            # 3 honest grants remain = quorum for rf=4 → commit succeeds
+            assert isinstance(resp.payload, Write2AnsFromServer)
+            assert resp.payload.result.operations[0].value == b"honest"
+            # and the forged grant was detected and dropped
+            assert vc.replicas[0].metrics.counters.get("replica.dropped-grants", 0) == 1
+
+    run(main())
+
+
+def test_certificate_below_quorum_after_forgeries_rejected():
+    async def main():
+        async with VirtualCluster(4, rf=4) as vc:
+            client = vc.client()
+            txn = TransactionBuilder().write("k", b"v").build()
+            grants = await write1_via_wire(vc, client, txn)
+
+            attacker = generate_keypair()
+            wc_grants = dict(grants)
+            for victim in ("server-1", "server-2"):  # forge 2 of 4 → only 2 honest < quorum 3
+                forged = replace(wc_grants[victim], signature=None)
+                wc_grants[victim] = forged.with_signature(
+                    attacker.sign(forged.signing_bytes())
+                )
+            env = client._envelope(
+                Write2ToServer(WriteCertificate(wc_grants), txn), "w2-thin"
+            )
+            resp = await client.pool.send_and_receive(vc.config.servers["server-0"], env)
+            assert isinstance(resp.payload, RequestFailedFromServer)
+            assert resp.payload.fail_type == FailType.BAD_CERTIFICATE
+
+    run(main())
+
+
+def test_tampered_multigrant_content_rejected():
+    async def main():
+        async with VirtualCluster(4, rf=4) as vc:
+            client = vc.client()
+            txn = TransactionBuilder().write("k", b"v").build()
+            grants = await write1_via_wire(vc, client, txn)
+            # Tamper with a signed grant's timestamp without re-signing: the
+            # signature no longer covers the content.
+            victim = "server-2"
+            mg = grants[victim]
+            bad = MultiGrant(
+                grants={
+                    k: replace(g, timestamp=g.timestamp + 5) for k, g in mg.grants.items()
+                },
+                client_id=mg.client_id,
+                server_id=mg.server_id,
+                signature=mg.signature,
+            )
+            wc = WriteCertificate({**grants, victim: bad})
+            env = client._envelope(Write2ToServer(wc, txn), "w2-tamper")
+            resp = await client.pool.send_and_receive(vc.config.servers["server-0"], env)
+            # Tampered grant dropped; remaining 3 honest grants still commit.
+            assert isinstance(resp.payload, Write2AnsFromServer)
+
+    run(main())
+
+
+def test_client_envelope_tampering_rejected_when_auth_required():
+    async def main():
+        async with VirtualCluster(4, rf=4, require_client_auth=True) as vc:
+            client = vc.client()
+            # Legitimate signed request works.
+            ok = await client.execute_write_transaction(
+                TransactionBuilder().write("k", b"v").build()
+            )
+            assert ok.operations[0].value == b"v"
+
+            # Tampered envelope: signature is over different content.
+            env = client._envelope(HelloToServer("legit"), "m-legit")
+            tampered = replace(env, payload=HelloToServer("evil"))
+            resp = await client.pool.send_and_receive(
+                vc.config.servers["server-0"], tampered
+            )
+            assert isinstance(resp.payload, RequestFailedFromServer)
+            assert resp.payload.fail_type == FailType.BAD_SIGNATURE
+
+    run(main())
+
+
+def test_unknown_client_rejected_when_auth_required():
+    async def main():
+        async with VirtualCluster(4, rf=4, require_client_auth=True) as vc:
+            legit = vc.client()  # registers its key
+            # A client whose key is NOT registered:
+            rogue = legit.__class__(config=vc.config)
+            try:
+                env = rogue._envelope(HelloToServer("hi"), "m-rogue")
+                resp = await rogue.pool.send_and_receive(
+                    vc.config.servers["server-0"], env
+                )
+                assert isinstance(resp.payload, RequestFailedFromServer)
+                assert resp.payload.fail_type == FailType.BAD_SIGNATURE
+            finally:
+                await rogue.close()
+
+    run(main())
+
+
+def test_response_impersonation_dropped_by_client():
+    async def main():
+        async with VirtualCluster(4, rf=4) as vc:
+            client = vc.client()
+            # A response claiming to be server-0 but signed by an attacker key
+            # must not count toward quorums.
+            attacker = generate_keypair()
+            env = Envelope(HelloToServer("x"), "m1", "server-0", reply_to="m0")
+            env = env.with_signature(attacker.sign(env.signing_bytes()))
+            assert not client._authentic("server-0", env)
+
+    run(main())

@@ -1,0 +1,135 @@
+"""Regression tests for review findings: out-of-set grants, signature
+stripping, seed-range attacks, codec int domain, config token bounds."""
+
+import asyncio
+
+import pytest
+
+from mochi_tpu.client import TransactionBuilder
+from mochi_tpu.cluster import ClusterConfig
+from mochi_tpu.protocol import (
+    Envelope,
+    FailType,
+    HelloToServer,
+    RequestFailedFromServer,
+    Transaction,
+    Operation,
+    Action,
+    Write1OkFromServer,
+    Write1ToServer,
+    Write2ToServer,
+    WriteCertificate,
+    transaction_hash,
+)
+from mochi_tpu.protocol.codec import decode, encode
+from mochi_tpu.server.store import BadRequest, DataStore
+from mochi_tpu.testing import VirtualCluster
+
+
+def run(coro):
+    asyncio.run(asyncio.wait_for(coro, timeout=60))
+
+
+def test_out_of_set_grants_do_not_count_toward_quorum():
+    # n=7, rf=4: servers outside a key's replica set may be compromised beyond
+    # the in-set f assumption; their (validly signed) grants must not form a
+    # committing certificate.
+    cfg = ClusterConfig.build(
+        {f"server-{i}": f"127.0.0.1:{8001 + i}" for i in range(7)}, rf=4
+    )
+    stores = {f"server-{i}": DataStore(f"server-{i}", cfg) for i in range(7)}
+    key = next(
+        k for k in (f"key-{i}" for i in range(1000))
+        if len(set(cfg.replica_set_for_key(k))) == 4
+        and len(set(cfg.servers) - set(cfg.replica_set_for_key(k))) >= 3
+    )
+    in_set = cfg.replica_set_for_key(key)
+    out_set = sorted(set(cfg.servers) - set(in_set))[:3]
+    txn = Transaction((Operation(Action.WRITE, key, b"evil"),))
+    blind = Transaction((Operation(Action.WRITE, key, None),))
+    req = Write1ToServer("attacker", blind, 5, transaction_hash(txn))
+    # Collect grants ONLY from out-of-set servers (they will issue them since
+    # owns() is False → WRONG_SHARD... so craft via one in-set grant plus
+    # out-of-set forgeries at the same timestamp).
+    from mochi_tpu.protocol import Grant, MultiGrant, Status
+
+    grants = {}
+    for sid in out_set:
+        grants[sid] = MultiGrant(
+            grants={key: Grant(key, 5, 1, transaction_hash(txn), Status.OK)},
+            client_id="attacker",
+            server_id=sid,
+        )
+    wc = WriteCertificate(grants)
+    victim = stores[in_set[0]]
+    result = victim.process_write2(Write2ToServer(wc, txn))
+    assert isinstance(result, RequestFailedFromServer)
+    assert result.fail_type == FailType.BAD_CERTIFICATE
+    assert victim.data.get(key) is None or victim.data[key].value != b"evil"
+
+
+def test_signature_stripping_rejected_even_in_open_mode():
+    async def main():
+        async with VirtualCluster(4, rf=4) as vc:  # open mode (no client auth)
+            client = vc.client()
+            env = Envelope(HelloToServer("spoof"), "m1", "server-1")  # known id, no sig
+            resp = await client.pool.send_and_receive(vc.config.servers["server-0"], env)
+            assert isinstance(resp.payload, RequestFailedFromServer)
+            assert resp.payload.fail_type == FailType.BAD_SIGNATURE
+
+    run(main())
+
+
+def test_out_of_range_seed_rejected():
+    cfg = ClusterConfig.build(
+        {f"server-{i}": f"127.0.0.1:{8001 + i}" for i in range(4)}, rf=4
+    )
+    store = DataStore("server-0", cfg)
+    blind = Transaction((Operation(Action.WRITE, "k", None),))
+    for bad_seed in (-1, 10**15, 1000):
+        with pytest.raises(BadRequest):
+            store.process_write1(Write1ToServer("c", blind, bad_seed, b"h"))
+
+
+def test_out_of_range_seed_rejected_over_wire():
+    async def main():
+        async with VirtualCluster(4, rf=4) as vc:
+            client = vc.client()
+            blind = Transaction((Operation(Action.WRITE, "k", None),))
+            env = client._envelope(
+                Write1ToServer(client.client_id, blind, 10**12, b"h" * 64), "m-seed"
+            )
+            resp = await client.pool.send_and_receive(vc.config.servers["server-0"], env)
+            assert isinstance(resp.payload, RequestFailedFromServer)
+            assert resp.payload.fail_type == FailType.BAD_REQUEST
+
+    run(main())
+
+
+def test_codec_int_domain_symmetric():
+    assert decode(encode((1 << 64) - 1)) == (1 << 64) - 1
+    assert decode(encode(-(1 << 64))) == -(1 << 64)
+    with pytest.raises(TypeError):
+        encode(1 << 64)
+    with pytest.raises(TypeError):
+        encode(-(1 << 64) - 1)
+
+
+def test_properties_token_bounds_checked():
+    cfg = ClusterConfig.build(
+        {f"server-{i}": f"127.0.0.1:{8001 + i}" for i in range(4)}, rf=4
+    )
+    text = cfg.to_properties().replace("_TOKENS=0,", "_TOKENS=-1,", 1)
+    with pytest.raises(ValueError, match="outside"):
+        ClusterConfig.from_properties(text)
+
+
+def test_timer_memory_bounded():
+    from mochi_tpu.utils.metrics import Timer
+
+    t = Timer(window=16)
+    for i in range(1000):
+        t.record(0.001)
+    assert len(t.samples) == 16
+    assert t.count == 1000
+    assert t.snapshot()["count"] == 1000
