@@ -146,10 +146,14 @@ class MochiDBClient:
             final: List = []
             for i in range(n_ops):
                 # Coalesce per-op results, ignoring WRONG_SHARD fillers
-                # (ref: MochiDBClient.java:148-175).
+                # (ref: MochiDBClient.java:148-175).  Only servers in the
+                # op's replica set get a vote: the fault bound (≤ f faulty of
+                # 3f+1) holds per set, so out-of-set responders — reached via
+                # the multi-key fan-out union — must not tip the tally.
+                rset = set(self.config.replica_set_for_key(transaction.operations[i].key))
                 tallies: Dict[bytes, Tuple[int, object]] = {}
-                for p in reads.values():
-                    if i >= len(p.result.operations):
+                for sid, p in reads.items():
+                    if sid not in rset or i >= len(p.result.operations):
                         continue
                     op_res = p.result.operations[i]
                     if op_res.status == Status.WRONG_SHARD:
@@ -175,18 +179,56 @@ class MochiDBClient:
             tuple(Operation(Action.WRITE, op.key, None) for op in transaction.operations)
         )
 
-    @staticmethod
-    def _uniform_timestamps(grants: Sequence[MultiGrant]) -> bool:
-        """All servers must offer the same timestamp per object
-        (ref: ``isUniformTimeStampInMultiGrants``, ``MochiDBClient.java:195-219``)."""
-        per_object: Dict[str, int] = {}
-        for mg in grants:
-            for key, grant in mg.grants.items():
-                if grant.status != Status.OK:
-                    continue
-                if per_object.setdefault(key, grant.timestamp) != grant.timestamp:
-                    return False
-        return True
+    def _quorum_grant_subset(
+        self, transaction: Transaction, oks: Sequence[MultiGrant]
+    ) -> Optional[List[MultiGrant]]:
+        """Largest timestamp-consistent MultiGrant subset with per-key quorum.
+
+        The reference demands *unanimous* timestamps across every responder
+        and retries otherwise (``isUniformTimeStampInMultiGrants``,
+        ``MochiDBClient.java:195-219,310-318``) — which lets a single
+        Byzantine or lagging replica stall all writes.  Instead: per key,
+        take the majority timestamp among that key's replica set; drop any
+        MultiGrant conflicting with a winning timestamp; accept if the
+        surviving grants still cover every key with >= 2f+1 distinct in-set
+        servers.  Returns None when no such subset exists (caller retries).
+        """
+        replica_sets = {
+            op.key: set(self.config.replica_set_for_key(op.key))
+            for op in transaction.operations
+        }
+        winning: Dict[str, int] = {}
+        for key, rset in replica_sets.items():
+            counts: Dict[int, int] = {}
+            for mg in oks:
+                grant = mg.grants.get(key)
+                if grant is not None and grant.status == Status.OK and mg.server_id in rset:
+                    counts[grant.timestamp] = counts.get(grant.timestamp, 0) + 1
+            if not counts:
+                return None
+            winning[key] = max(counts.items(), key=lambda kv: kv[1])[0]
+        chosen = [
+            mg
+            for mg in oks
+            if all(
+                g.timestamp == winning[key]
+                for key, g in mg.grants.items()
+                if key in winning and g.status == Status.OK
+            )
+        ]
+        # Re-check coverage on the survivors (dropping a conflicted MultiGrant
+        # removes all its keys' votes at once).
+        for key, rset in replica_sets.items():
+            voters = {
+                mg.server_id
+                for mg in chosen
+                if mg.server_id in rset
+                and (g := mg.grants.get(key)) is not None
+                and g.status == Status.OK
+            }
+            if len(voters) < self.config.quorum:
+                return None
+        return chosen
 
     async def execute_write_transaction(self, transaction: Transaction) -> TransactionResult:
         """2-phase write: Write1 grant acquisition → Write2 certificate commit
@@ -202,15 +244,17 @@ class MochiDBClient:
                     lambda: Write1ToServer(self.client_id, write1_txn, seed, txn_hash),
                 )
                 oks: List[MultiGrant] = []
-                refused = False
                 for sid, p in responses.items():
                     if isinstance(p, Write1OkFromServer) and p.multi_grant.server_id == sid:
                         oks.append(p.multi_grant)
-                    elif isinstance(p, Write1RefusedFromServer):
-                        refused = True
-                if refused or len(oks) < self.config.quorum:
-                    # Seed collision with another in-flight transaction (or
-                    # missing responses): back off, fresh seed
+                # Proceed as soon as a timestamp-consistent 2f+1 subset
+                # exists; refusals/outliers from up to f servers (contention,
+                # lag, Byzantine skew) must not block an honest quorum.
+                chosen = self._quorum_grant_subset(transaction, oks)
+                if chosen is None:
+                    # Seed collision with another in-flight transaction,
+                    # missing responses, or split timestamps: back off and
+                    # retry with a fresh seed
                     # (ref: MochiDBClient.java:310-328 — refusal aborted there).
                     refusals += 1
                     if refusals > self.refusal_retries:
@@ -220,12 +264,7 @@ class MochiDBClient:
                         )
                     await asyncio.sleep(0.001 * (1 + attempt))
                     continue
-                if not self._uniform_timestamps(oks):
-                    # Replicas disagree on epochs (lagging replica):
-                    # ref sleeps 1 ms and retries (MochiDBClient.java:310-318).
-                    await asyncio.sleep(0.001)
-                    continue
-                certificate = WriteCertificate({mg.server_id: mg for mg in oks})
+                certificate = WriteCertificate({mg.server_id: mg for mg in chosen})
                 return await self._write2(transaction, certificate)
             raise RequestRefused(f"write did not converge in {self.write_attempts} attempts")
 
@@ -238,9 +277,12 @@ class MochiDBClient:
         n_ops = len(transaction.operations)
         final: List = []
         for i in range(n_ops):
+            # Per-op votes restricted to the key's replica set (same
+            # out-of-set exclusion as the read path).
+            rset = set(self.config.replica_set_for_key(transaction.operations[i].key))
             tallies: Dict[Tuple, Tuple[int, object]] = {}
-            for p in responses.values():
-                if not isinstance(p, Write2AnsFromServer):
+            for sid, p in responses.items():
+                if sid not in rset or not isinstance(p, Write2AnsFromServer):
                     continue
                 if i >= len(p.result.operations):
                     continue

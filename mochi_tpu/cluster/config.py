@@ -91,6 +91,14 @@ class ClusterConfig:
     rf: int  # BFT replication factor (ref: _CONFIG_BFT_REPLICATION)
     configstamp: int = 1  # ref: ClusterConfiguration.java:41 (reconfiguration epoch)
     public_keys: Dict[str, bytes] = field(default_factory=dict)  # server_id -> Ed25519 pubkey (32B)
+    # token -> replica set memo: the ring walk is O(SHARD_TOKENS) and sits on
+    # every request's hot path (client targeting + server owns()/coalesce).
+    # Invalidated implicitly by constructing a new config (reconfiguration
+    # bumps configstamp and rebuilds the object; token_owners is never
+    # mutated in place).
+    _replica_set_cache: Dict[int, List[str]] = field(
+        default_factory=dict, repr=False, compare=False
+    )
 
     # ---------------------------------------------------------------- quorums
 
@@ -125,6 +133,9 @@ class ClusterConfig:
         (ref: ``ClusterConfiguration.java:207-226``, intended per
         ``mochiDB.tex:173-183``; the shipped code's lookup bug is fixed here).
         """
+        cached = self._replica_set_cache.get(token)
+        if cached is not None:
+            return cached
         owners: List[str] = []
         seen = set()
         for i in range(SHARD_TOKENS):
@@ -133,6 +144,7 @@ class ClusterConfig:
                 seen.add(owner)
                 owners.append(owner)
                 if len(owners) == self.rf:
+                    self._replica_set_cache[token] = owners
                     return owners
         raise ValueError(
             f"ring has only {len(owners)} distinct owners < rf={self.rf}"

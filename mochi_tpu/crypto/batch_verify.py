@@ -1,0 +1,204 @@
+"""Batched Ed25519 verification: host preprocessing + jitted device kernel.
+
+Division of labor (the TPU-first design, SURVEY.md §7):
+
+* **Host** — everything variable-length or trivially cheap: SHA-512 of
+  R||A||M (hashlib -> OpenSSL C, ~GB/s), the mod-L scalar reduction
+  (python bignum), RFC 8032 canonical-encoding prechecks (y < p, S < L),
+  and packing into fixed-shape int32 tensors.
+* **Device** — all the modular heavy lifting (~4400 field muls per
+  signature): point decompression (two fixed exponentiation chains) and the
+  256-step double-scalar-mul, batched over the leading axis.
+
+Batches are padded to power-of-two buckets so XLA compiles a handful of
+program shapes, then caches (SURVEY.md §7: static shapes; first compile
+20-40s, later calls cached).
+
+The verdict matches the CPU path (OpenSSL cofactorless verify) bit-for-bit;
+``tests/test_crypto_jax.py`` checks this differentially including forged and
+malformed inputs.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import curve
+from . import field as F
+from ..verifier.spi import VerifyItem
+
+MIN_BUCKET = 16
+
+
+def _bucket_size(n: int) -> int:
+    m = MIN_BUCKET
+    while m < n:
+        m *= 2
+    return m
+
+
+def prepare(items: Sequence[VerifyItem]):
+    """Host-side packing: items -> dict of numpy tensors + precheck bitmap."""
+    n = len(items)
+    y_a = np.zeros((n, F.NLIMBS), dtype=np.int32)
+    y_r = np.zeros((n, F.NLIMBS), dtype=np.int32)
+    sign_a = np.zeros(n, dtype=np.int32)
+    sign_r = np.zeros(n, dtype=np.int32)
+    s_bits = np.zeros((n, 256), dtype=np.int32)
+    h_bits = np.zeros((n, 256), dtype=np.int32)
+    pre_ok = np.zeros(n, dtype=bool)
+
+    for i, it in enumerate(items):
+        if len(it.public_key) != 32 or len(it.signature) != 64:
+            continue
+        a_bytes = bytes(it.public_key)
+        r_bytes = bytes(it.signature[:32])
+        s_int = int.from_bytes(it.signature[32:], "little")
+        ya = int.from_bytes(a_bytes, "little") & ((1 << 255) - 1)
+        yr = int.from_bytes(r_bytes, "little") & ((1 << 255) - 1)
+        # RFC 8032 decode rejects non-canonical y and S >= L (as OpenSSL does)
+        if ya >= F.P_INT or yr >= F.P_INT or s_int >= F.L_INT:
+            continue
+        h_int = (
+            int.from_bytes(
+                hashlib.sha512(r_bytes + a_bytes + bytes(it.message)).digest(),
+                "little",
+            )
+            % F.L_INT
+        )
+        y_a[i] = F.int_to_limbs(ya)
+        y_r[i] = F.int_to_limbs(yr)
+        sign_a[i] = a_bytes[31] >> 7
+        sign_r[i] = r_bytes[31] >> 7
+        s_bits[i] = np.unpackbits(
+            np.frombuffer(s_int.to_bytes(32, "little"), dtype=np.uint8),
+            bitorder="little",
+        )
+        h_bits[i] = np.unpackbits(
+            np.frombuffer(h_int.to_bytes(32, "little"), dtype=np.uint8),
+            bitorder="little",
+        )
+        pre_ok[i] = True
+    return y_a, sign_a, y_r, sign_r, s_bits, h_bits, pre_ok
+
+
+_verify_jit = jax.jit(curve.verify_prepared)
+
+
+def verify_batch(
+    items: Sequence[VerifyItem], device: Optional[jax.Device] = None
+) -> List[bool]:
+    """Verify a batch of Ed25519 signatures on the default JAX device.
+
+    Returns a python bool list (the SPI bitmap).  Invalid encodings are
+    rejected on host; padding lanes carry pre_ok=False and are sliced away.
+    """
+    if not items:
+        return []
+    y_a, sign_a, y_r, sign_r, s_bits, h_bits, pre_ok = prepare(items)
+    n = len(items)
+    m = _bucket_size(n)
+    if m != n:
+        pad = ((0, m - n), (0, 0))
+        y_a = np.pad(y_a, pad)
+        y_r = np.pad(y_r, pad)
+        s_bits = np.pad(s_bits, pad)
+        h_bits = np.pad(h_bits, pad)
+        sign_a = np.pad(sign_a, ((0, m - n),))
+        sign_r = np.pad(sign_r, ((0, m - n),))
+    args = (y_a, sign_a, y_r, sign_r, s_bits, h_bits)
+    if device is not None:
+        args = tuple(jax.device_put(a, device) for a in args)
+    bitmap = np.asarray(_verify_jit(*args))[:n]
+    return [bool(b) for b in np.logical_and(bitmap, pre_ok)]
+
+
+class JaxBatchBackend:
+    """``BatchBackend`` for :class:`mochi_tpu.verifier.spi.BatchingVerifier`.
+
+    The replica's async batcher calls this from a thread executor; each call
+    is one device program launch (cached compile per bucket shape).
+
+    Compile-stall avoidance: XLA compiles one program per batch-size bucket
+    (20-60s each).  A batch whose bucket isn't compiled yet is served in
+    chunks of the largest *already-compiled* bucket, while the bigger
+    bucket's compile is kicked off on a background thread — so ramping load
+    never parks live traffic behind a compile (it would blow client
+    timeouts; see the batching discipline in SURVEY.md §7).
+    """
+
+    def __init__(self, device: Optional[jax.Device] = None):
+        self.device = device
+        self._ready: set[int] = set()
+        self._compiling: set[int] = set()
+        self._lock = threading.Lock()
+
+    def warmup(self, batch_sizes: Sequence[int]) -> None:
+        """Synchronously pre-compile the given bucket sizes (boot path)."""
+        for n in batch_sizes:
+            bucket = _bucket_size(n)
+            verify_batch(_dummy_items(bucket), device=self.device)
+            with self._lock:
+                self._ready.add(bucket)
+
+    def _compile_in_background(self, bucket: int) -> None:
+        def run():
+            try:
+                items = _dummy_items(bucket)
+                verify_batch(items, device=self.device)
+                with self._lock:
+                    self._ready.add(bucket)
+            except Exception:  # pragma: no cover - diagnostics only
+                pass
+            finally:
+                with self._lock:
+                    self._compiling.discard(bucket)
+
+        threading.Thread(target=run, name=f"verify-warm-{bucket}", daemon=True).start()
+
+    def __call__(self, items: Sequence[VerifyItem]) -> Sequence[bool]:
+        bucket = _bucket_size(len(items))
+        with self._lock:
+            ready_now = bucket in self._ready
+            largest_ready = max(self._ready, default=0)
+            if not ready_now and largest_ready and bucket not in self._compiling:
+                self._compiling.add(bucket)
+                schedule = True
+            else:
+                schedule = False
+        if ready_now or not largest_ready:
+            # Bucket compiled, or nothing compiled yet (first ever call):
+            # run directly (the latter eats one synchronous compile — servers
+            # avoid it via boot-time warmup).
+            out = verify_batch(items, device=self.device)
+            with self._lock:
+                self._ready.add(bucket)
+            return out
+        if schedule:
+            self._compile_in_background(bucket)
+        out: List[bool] = []
+        for i in range(0, len(items), largest_ready):
+            out.extend(verify_batch(items[i : i + largest_ready], device=self.device))
+        return out
+
+
+def _dummy_items(n: int) -> List[VerifyItem]:
+    from .keys import generate_keypair
+
+    kp = generate_keypair()
+    msg = b"mochi-tpu warmup"
+    sig = kp.sign(msg)
+    return [VerifyItem(kp.public_key, msg, sig)] * n
+
+
+def warmup(batch_sizes: Sequence[int] = (MIN_BUCKET,)) -> None:
+    """Pre-compile the verify program for the given bucket sizes."""
+    for n in batch_sizes:
+        verify_batch(_dummy_items(_bucket_size(n)))

@@ -1,0 +1,184 @@
+"""Ed25519 group operations in extended twisted-Edwards coordinates — JAX.
+
+Everything is branchless and fixed-shape so the whole verify lowers to one
+XLA program: the addition law used here is the *complete* law for twisted
+Edwards curves with a = -1 (a is a square mod p since p === 1 mod 4, d is a
+non-square), so identity/doubling/degenerate cases need no case analysis —
+exactly the property that makes Ed25519 verification map cleanly onto a
+vector machine (SURVEY.md §7: "no data-dependent Python control flow").
+
+A point is a 4-tuple (X, Y, Z, T) of field elements (int32 ``(..., 16)``
+limb arrays, :mod:`mochi_tpu.crypto.field`), with x = X/Z, y = Y/Z,
+T = XY/Z.  Scalars arrive as little-endian bit arrays ``(..., 256)``
+precomputed on the host (the host also does SHA-512 and the mod-L
+reduction: variable-length hashing is host work; the device sees only
+fixed-shape integer tensors).
+
+The reference never implements any of this (it never signs — SURVEY.md
+preamble); this is the north-star TPU verifier path of BASELINE.json.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax.numpy as jnp
+from jax import lax
+
+from . import field as F
+
+
+class Point(NamedTuple):
+    """Extended coordinates (X : Y : Z : T), x=X/Z, y=Y/Z, T=XY/Z."""
+
+    x: jnp.ndarray
+    y: jnp.ndarray
+    z: jnp.ndarray
+    t: jnp.ndarray
+
+
+def identity(batch_shape) -> Point:
+    zero = F.zeros_like_batch(batch_shape)
+    one = zero.at[..., 0].set(1)
+    return Point(zero, one, one, zero)
+
+
+def basepoint(batch_shape) -> Point:
+    """The Ed25519 basepoint B, broadcast over a batch."""
+    bx = jnp.broadcast_to(F.const(F.BX_INT), (*batch_shape, F.NLIMBS))
+    by = jnp.broadcast_to(F.const(F.BY_INT), (*batch_shape, F.NLIMBS))
+    one = F.zeros_like_batch(batch_shape).at[..., 0].set(1)
+    return Point(bx, by, one, F.mul(bx, by))
+
+
+# 2*d mod p, a trace-time constant
+_D2_INT = (2 * F.D_INT) % F.P_INT
+
+
+def add(p: Point, q: Point) -> Point:
+    """Complete unified addition (add-2008-hwcd-3, a=-1). ~9 field muls."""
+    a = F.mul(F.sub(p.y, p.x), F.sub(q.y, q.x))
+    b = F.mul(F.add(p.y, p.x), F.add(q.y, q.x))
+    c = F.mul(F.mul(p.t, F.const(_D2_INT)), q.t)
+    d = F.mul(F.add(p.z, p.z), q.z)
+    e = F.sub(b, a)
+    f = F.sub(d, c)
+    g = F.add(d, c)
+    h = F.add(b, a)
+    return Point(F.mul(e, f), F.mul(g, h), F.mul(f, g), F.mul(e, h))
+
+
+def double(p: Point) -> Point:
+    """Doubling (dbl-2008-hwcd, a=-1). ~4 muls + 4 squares."""
+    a = F.square(p.x)
+    b = F.square(p.y)
+    c = F.mul_small(F.square(p.z), 2)
+    h = F.add(a, b)
+    e = F.sub(h, F.square(F.add(p.x, p.y)))
+    g = F.sub(a, b)
+    f = F.add(c, g)
+    return Point(F.mul(e, f), F.mul(g, h), F.mul(f, g), F.mul(e, h))
+
+
+def negate(p: Point) -> Point:
+    return Point(F.neg(p.x), p.y, p.z, F.neg(p.t))
+
+
+def select_point(cond: jnp.ndarray, p: Point, q: Point) -> Point:
+    return Point(*(F.select(cond, a, b) for a, b in zip(p, q)))
+
+
+def decompress(y_limbs: jnp.ndarray, sign: jnp.ndarray) -> Tuple[Point, jnp.ndarray]:
+    """RFC 8032 §5.1.3 point decoding, batched and branchless.
+
+    ``y_limbs``: (..., 16) with y < p (host prechecks canonicity);
+    ``sign``: (...,) int32 in {0,1} — the x-parity bit from byte 31.
+    Returns (point with Z=1, ok) where ok=False marks non-points
+    (x^2 = u/v has no root, or x=0 with sign=1).
+    """
+    yy = F.square(y_limbs)
+    one = F.zeros_like_batch(y_limbs.shape[:-1]).at[..., 0].set(1)
+    u = F.sub(yy, one)  # y^2 - 1
+    v = F.add(F.mul(yy, F.const(F.D_INT)), one)  # d*y^2 + 1
+
+    # candidate root x = u * v^3 * (u*v^7)^((p-5)/8)
+    v3 = F.mul(F.square(v), v)
+    v7 = F.mul(F.square(v3), v)
+    x = F.mul(F.mul(u, v3), F.pow_p58(F.mul(u, v7)))
+
+    vxx = F.mul(v, F.square(x))
+    root_ok = F.eq(vxx, u)
+    root_neg = F.eq(vxx, F.neg(u))
+    x = F.select(root_neg, F.mul(x, F.const(F.SQRT_M1_INT)), x)
+    ok = root_ok | root_neg
+
+    x_can = F.canonical(x)
+    x_is_zero = F.is_zero(x)
+    ok = ok & ~(x_is_zero & (sign == 1))
+    # flip sign to match the encoded parity bit
+    flip = (x_can[..., 0] & 1) != sign
+    x = F.select(flip, F.neg(x), x)
+
+    return Point(x, y_limbs, one, F.mul(x, y_limbs)), ok
+
+
+def double_scalar_mul(
+    s_bits: jnp.ndarray, p_bits: jnp.ndarray, p_point: Point
+) -> Point:
+    """[s]B + [p]P by joint 1-bit Straus: 256 x (double + complete add).
+
+    ``s_bits``/``p_bits``: (..., 256) little-endian bits.  The 4-entry
+    table {O, B, P, B+P} is gathered per item per iteration — data-dependent
+    *gathers* are fine under jit; only control flow must be static.
+    """
+    batch_shape = s_bits.shape[:-1]
+    bp = basepoint(batch_shape)
+    tab_o = identity(batch_shape)
+    tab_bp = add(bp, p_point)
+    # per coordinate: (..., 4, limbs) — table entries stacked on a new axis
+    table = [
+        jnp.stack([o, b, p, s], axis=-2)
+        for o, b, p, s in zip(tab_o, bp, p_point, tab_bp)
+    ]
+
+    def body(i, q):
+        bit_idx = 255 - i
+        sb = s_bits[..., bit_idx]
+        pb = p_bits[..., bit_idx]
+        q = double(q)
+        idx = (sb + 2 * pb).astype(jnp.int32)
+        entry = Point(
+            *(
+                jnp.take_along_axis(t, idx[..., None, None], axis=-2).squeeze(-2)
+                for t in table
+            )
+        )
+        return add(q, entry)
+
+    q0 = identity(batch_shape)
+    q = lax.fori_loop(0, 256, body, q0)
+    return q
+
+
+def verify_prepared(
+    y_a: jnp.ndarray,
+    sign_a: jnp.ndarray,
+    y_r: jnp.ndarray,
+    sign_r: jnp.ndarray,
+    s_bits: jnp.ndarray,
+    h_bits: jnp.ndarray,
+) -> jnp.ndarray:
+    """Core batched verify on host-prepared tensors -> validity bitmap.
+
+    Checks the cofactorless equation [S]B == R + [h]A (as OpenSSL/the CPU
+    path does), rearranged to Q := [S]B + [h](-A), Q == R, compared
+    projectively (X_Q == x_R * Z_Q, Y_Q == y_R * Z_Q) to avoid an inversion.
+    SHA-512, mod-L reduction, and canonical-encoding prechecks (y < p, S < L)
+    happen on the host (:mod:`mochi_tpu.crypto.batch_verify`).
+    """
+    a_point, ok_a = decompress(y_a, sign_a)
+    r_point, ok_r = decompress(y_r, sign_r)
+    q = double_scalar_mul(s_bits, h_bits, negate(a_point))
+    eq_x = F.eq(q.x, F.mul(r_point.x, q.z))
+    eq_y = F.eq(q.y, F.mul(r_point.y, q.z))
+    return ok_a & ok_r & eq_x & eq_y

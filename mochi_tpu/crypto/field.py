@@ -1,0 +1,248 @@
+"""GF(2^255-19) arithmetic in 16x16-bit limbs, pure int32 — TPU-native.
+
+Design notes (why this representation):
+
+* TPU VPU/MXU have native int32 multiply; int64 is emulated by XLA.  SURVEY.md
+  §7 calls for limb decomposition so everything stays in int32 ops.  We use
+  **16 limbs x 16 bits** (radix 2^16, little-endian).  A 16x16-bit product
+  fits uint32 exactly ((2^16-1)^2 < 2^32), and after splitting each partial
+  product into lo/hi 16-bit halves, a schoolbook column accumulates at most
+  32 terms < 2^16, i.e. < 2^21 — comfortably inside int32.
+* All functions are shape-polymorphic over leading batch dims: a field element
+  is an int32 array ``(..., 16)`` with limbs in ``[0, 2^16)`` ("loosely
+  reduced": the represented value is < 2^256, congruent mod p to the true
+  value).  :func:`canonical` produces the unique representative < p.
+* No data-dependent control flow — everything is branchless select/arithmetic
+  so the whole verifier jits into one XLA program (SURVEY.md §7 "no
+  data-dependent Python control flow inside jit").
+
+The reference implements no field arithmetic anywhere (it never signs:
+``MochiProtocol.proto:123`` TODO, SURVEY.md preamble); this module is part of
+the north-star TPU verifier that completes the reference's declared design.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NLIMBS = 16
+RADIX = 16
+MASK = (1 << RADIX) - 1
+
+# p = 2^255 - 19
+P_INT = (1 << 255) - 19
+# curve constant d = -121665/121666 mod p
+D_INT = 37095705934669439343138083508754565189542113879843219016388785533085940283555
+# sqrt(-1) mod p (2^((p-1)/4))
+SQRT_M1_INT = 19681161376707505956807079304988542015446066515923890162744021073123829784752
+# group order L = 2^252 + 27742317777372353535851937790883648493
+L_INT = (1 << 252) + 27742317777372353535851937790883648493
+
+# Ed25519 basepoint (affine)
+BX_INT = 15112221349535400772501151409588531511454012693041857206046113283949847762202
+BY_INT = 46316835694926478169428394003475163141307993866256225615783033603165251855960
+
+
+def int_to_limbs(x: int) -> np.ndarray:
+    """Host-side: python int -> 16 int32 limbs (little-endian, radix 2^16)."""
+    return np.array([(x >> (RADIX * i)) & MASK for i in range(NLIMBS)], dtype=np.int32)
+
+
+def limbs_to_int(limbs) -> int:
+    """Host-side: 1-D limb array -> python int (no reduction)."""
+    arr = np.asarray(limbs).reshape(NLIMBS)
+    return sum(int(arr[i]) << (RADIX * i) for i in range(NLIMBS))
+
+
+def limbs_to_int_batch(limbs) -> list:
+    """Host-side: (..., 16) limb array -> list of python ints over last axis."""
+    arr = np.asarray(limbs).reshape(-1, NLIMBS)
+    out = []
+    for row in arr:
+        out.append(sum(int(row[i]) << (RADIX * i) for i in range(NLIMBS)))
+    return out
+
+
+def bytes32_to_limbs(b: bytes) -> np.ndarray:
+    """32 little-endian bytes -> limbs (full 256 bits, no masking)."""
+    assert len(b) == 32
+    x = int.from_bytes(b, "little")
+    return int_to_limbs(x)
+
+
+# Device-resident constants (built lazily so importing this module doesn't
+# touch a backend).
+def const(x: int) -> jnp.ndarray:
+    return jnp.asarray(int_to_limbs(x))
+
+
+def zeros_like_batch(batch_shape) -> jnp.ndarray:
+    return jnp.zeros((*batch_shape, NLIMBS), dtype=jnp.int32)
+
+
+def _carry_chain(cols: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Signed sequential carry over 16 columns -> (canonical limbs, carry-out).
+
+    ``cols`` is int32 (..., 16) with |col| < 2^27 or so; returns limbs in
+    [0, 2^16) and the signed carry out of limb 15 (value = limbs + cout*2^256).
+    Unrolled python loop: 16 iterations, traced once under jit.
+    """
+    c = jnp.zeros(cols.shape[:-1], dtype=jnp.int32)
+    out = []
+    for k in range(NLIMBS):
+        t = cols[..., k] + c
+        out.append(t & MASK)
+        c = t >> RADIX  # arithmetic shift: correct for negative t
+    return jnp.stack(out, axis=-1), c
+
+
+def _fold_carry(limbs: jnp.ndarray, cout: jnp.ndarray) -> jnp.ndarray:
+    """Fold carry-out: 2^256 === 38 (mod p). Adds 38*cout to limb 0, re-carries."""
+    cols = limbs.at[..., 0].add(38 * cout)
+    limbs2, cout2 = _carry_chain(cols)
+    # A second fold can only produce cout2 in {-1,0,1}; one more pass settles it
+    # (see module docstring bounds analysis; third carry-out is provably 0).
+    cols3 = limbs2.at[..., 0].add(38 * cout2)
+    limbs3, _ = _carry_chain(cols3)
+    return limbs3
+
+
+def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    limbs, cout = _carry_chain(a + b)
+    return _fold_carry(limbs, cout)
+
+
+# 2^256 - 38 == 2*p, as limbs: all 0xFFFF except limb0 = 0xFFDA.
+_TWO_P_LIMBS = np.full(NLIMBS, MASK, dtype=np.int32)
+_TWO_P_LIMBS[0] = MASK - 37
+
+
+def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """a - b mod p.  Adds 2p so columns stay > -2^16 before the signed chain."""
+    cols = a + jnp.asarray(_TWO_P_LIMBS) - b
+    limbs, cout = _carry_chain(cols)
+    return _fold_carry(limbs, cout)
+
+
+def neg(a: jnp.ndarray) -> jnp.ndarray:
+    return sub(jnp.zeros_like(a), a)
+
+
+def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Schoolbook 16x16-limb multiply with lo/hi split, fold at 2^256===38.
+
+    Partial products p[i,j] = a[i]*b[j] (< 2^32, computed in uint32 then
+    bit-split so every accumulated term is < 2^16).  Column k of the 32-column
+    product gets lo-halves with i+j==k and hi-halves with i+j==k-1: <= 32
+    terms < 2^16 -> column < 2^21.  High 16 columns fold back as 38*col
+  (2^256 === 38 mod p): columns < 38*2^21 + 2^21 < 2^27, safely int32.
+    """
+    au = a.astype(jnp.uint32)
+    bu = b.astype(jnp.uint32)
+    # (..., 16, 16) outer products
+    prod = au[..., :, None] * bu[..., None, :]
+    lo = (prod & MASK).astype(jnp.int32)
+    hi = (prod >> RADIX).astype(jnp.int32)
+
+    batch_shape = prod.shape[:-2]
+    cols = jnp.zeros((*batch_shape, 2 * NLIMBS), dtype=jnp.int32)
+    # Accumulate anti-diagonals. Unrolled: 16 scatter-adds of shifted rows.
+    for i in range(NLIMBS):
+        cols = lax.dynamic_update_slice_in_dim(
+            cols,
+            lax.dynamic_slice_in_dim(cols, i, NLIMBS, axis=-1) + lo[..., i, :],
+            i,
+            axis=-1,
+        )
+        cols = lax.dynamic_update_slice_in_dim(
+            cols,
+            lax.dynamic_slice_in_dim(cols, i + 1, NLIMBS, axis=-1) + hi[..., i, :],
+            i + 1,
+            axis=-1,
+        )
+    low, high = cols[..., :NLIMBS], cols[..., NLIMBS:]
+    folded = low + 38 * high
+    limbs, cout = _carry_chain(folded)
+    return _fold_carry(limbs, cout)
+
+
+def square(a: jnp.ndarray) -> jnp.ndarray:
+    return mul(a, a)
+
+
+def mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Multiply by a small python constant.
+
+    For k < 2^14 the limbwise product stays inside int32 (2^16 * 2^14 = 2^30)
+    and a single carry chain suffices; larger constants route through the full
+    multiply with a constant operand (XLA folds the broadcast).
+    """
+    if 0 <= k < (1 << 14):
+        limbs, cout = _carry_chain(a * k)
+        return _fold_carry(limbs, cout)
+    return mul(a, const(k % P_INT))
+
+
+def canonical(a: jnp.ndarray) -> jnp.ndarray:
+    """Reduce a loosely-reduced element (< 2^256) to the unique rep < p.
+
+    Value < 2^256 = 2p + 38, so at most two conditional subtractions of p.
+    Branchless: compute a - p with borrow; keep if nonnegative.
+    """
+    p_limbs = const(P_INT)
+
+    def cond_sub_p(x):
+        cols = x - p_limbs
+        limbs, cout = _carry_chain(cols)
+        nonneg = cout >= 0  # x >= p
+        return jnp.where(nonneg[..., None], limbs, x)
+
+    return cond_sub_p(cond_sub_p(a))
+
+
+def eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Field equality (canonicalizes both sides). Returns bool (...,)."""
+    return jnp.all(canonical(a) == canonical(b), axis=-1)
+
+
+def is_zero(a: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(canonical(a) == 0, axis=-1)
+
+
+def select(cond: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Branchless limb select: cond (...,) bool -> a or b (..., 16)."""
+    return jnp.where(cond[..., None], a, b)
+
+
+def _pow_const(a: jnp.ndarray, e: int) -> jnp.ndarray:
+    """a^e for a fixed public exponent, via lax.scan square-and-multiply.
+
+    The loop body (1 square + 1 branchless multiply) compiles once; the bit
+    sequence rides along as a scanned constant array.  Exponents here are
+    public protocol constants, so non-constant-time is fine (this is verify,
+    not sign — SURVEY.md §7).
+    """
+    bits_str = bin(e)[2:]  # MSB first
+    bits = jnp.asarray([int(c) for c in bits_str[1:]], dtype=jnp.int32)
+
+    def body(acc, bit):
+        acc = square(acc)
+        acc = select((bit == 1), mul(acc, a), acc)
+        return acc, None
+
+    acc, _ = lax.scan(body, a, bits)
+    return acc
+
+
+def pow_p58(a: jnp.ndarray) -> jnp.ndarray:
+    """a^((p-5)/8) = a^(2^252 - 3): the sqrt-ratio exponentiation."""
+    return _pow_const(a, (1 << 252) - 3)
+
+
+def invert(a: jnp.ndarray) -> jnp.ndarray:
+    """a^(p-2) (Fermat)."""
+    return _pow_const(a, P_INT - 2)

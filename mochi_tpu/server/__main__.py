@@ -42,7 +42,10 @@ async def amain(args) -> None:
             from ..verifier.tpu import TpuBatchVerifier
         except ImportError as exc:
             raise SystemExit(f"TPU verifier unavailable ({exc}); use --verifier cpu") from exc
-        verifier = TpuBatchVerifier()
+        # Warm the XLA cache at boot (first compile is 20-60s; doing it here
+        # keeps it out of the first client's commit latency) — READY is only
+        # printed once the verifier can serve.
+        verifier = TpuBatchVerifier(warmup_buckets=(16,))
     replica = MochiReplica(
         server_id=args.server_id,
         config=config,

@@ -246,19 +246,27 @@ class DataStore:
         the quorum.
         """
         coalesced: Dict[str, Tuple[int, List[Grant]]] = {}
-        replica_sets = {op.key: set(self.config.replica_set_for_key(op.key)) for op in transaction.operations}
+        replica_sets = {
+            op.key: set(self.config.replica_set_for_key(op.key))
+            for op in transaction.operations
+        }
+        # One vote per (key, server): iterate unique keys, and dedupe
+        # contributing servers so a duplicate-key transaction (or a MultiGrant
+        # repeated under two server ids) can't inflate the quorum count.
+        seen: Dict[str, set] = {key: set() for key in replica_sets}
         for mg in wc.grants.values():
-            for op in transaction.operations:
-                grant = mg.grants.get(op.key)
+            for key, rset in replica_sets.items():
+                grant = mg.grants.get(key)
                 if grant is None or grant.status != Status.OK:
                     continue
-                if mg.server_id not in replica_sets[op.key]:
+                if mg.server_id not in rset or mg.server_id in seen[key]:
                     continue
-                entry = coalesced.get(op.key)
+                seen[key].add(mg.server_id)
+                entry = coalesced.get(key)
                 if entry is None:
-                    coalesced[op.key] = (grant.timestamp, [grant])
+                    coalesced[key] = (grant.timestamp, [grant])
                 elif entry[0] != grant.timestamp:
-                    raise BadCertificate(f"grant timestamps disagree for {op.key}")
+                    raise BadCertificate(f"grant timestamps disagree for {key}")
                 else:
                     entry[1].append(grant)
         return coalesced

@@ -1,0 +1,51 @@
+"""TPU-backed batch verifier: the BASELINE.json ``TpuBatchVerifier``.
+
+Composition of the two halves built elsewhere:
+
+* :class:`mochi_tpu.verifier.spi.BatchingVerifier` — async micro-batching
+  with a CPU fallback (never skips verification on device failure);
+* :class:`mochi_tpu.crypto.batch_verify.JaxBatchBackend` — one jitted XLA
+  program per batch-size bucket running the limb-decomposed Ed25519
+  pipeline (decompress + double-scalar-mul) on the default JAX device.
+
+Unlike BASELINE.json's sketch (gRPC sidecar between a JVM replica and a JAX
+process), this framework's replicas are *already* in the JAX process, so the
+batcher feeds the device in-process — one IPC hop less on the hot path.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+
+from ..crypto.batch_verify import JaxBatchBackend
+from .spi import BatchingVerifier, SignatureVerifier
+
+
+class TpuBatchVerifier(BatchingVerifier):
+    """BatchingVerifier over the JAX device backend.
+
+    ``max_batch``/``max_delay_s`` implement the batching discipline of
+    SURVEY.md §7: ship partial batches on a timer so p50 commit latency stays
+    bounded at low load while large batches amortize device launches at high
+    load.
+    """
+
+    def __init__(
+        self,
+        device: Optional[jax.Device] = None,
+        max_batch: int = 4096,
+        max_delay_s: float = 0.002,
+        fallback: Optional[SignatureVerifier] = None,
+        warmup_buckets: Sequence[int] = (),
+    ):
+        jax_backend = JaxBatchBackend(device=device)
+        super().__init__(
+            backend=jax_backend,
+            max_batch=max_batch,
+            max_delay_s=max_delay_s,
+            fallback=fallback,
+        )
+        if warmup_buckets:
+            jax_backend.warmup(warmup_buckets)

@@ -1,0 +1,123 @@
+"""Differential tests: JAX Ed25519 verifier vs the OpenSSL CPU path.
+
+The TPU verifier must agree bit-for-bit with the CPU fallback on valid,
+forged, and malformed inputs (SURVEY.md §7: "correctness-tested against the
+CPU path"; §4 "validity bitmap on mixed valid/forged batches").  Field
+arithmetic is additionally checked against python bignums.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from mochi_tpu.crypto import batch_verify as BV
+from mochi_tpu.crypto import field as F
+from mochi_tpu.crypto.keys import generate_keypair, verify as cpu_verify
+from mochi_tpu.verifier.spi import VerifyItem
+
+
+class TestField:
+    def _rand_pairs(self, n=8, seed=1):
+        rng = random.Random(seed)
+        xs = [rng.randrange(0, 1 << 256) for _ in range(n)]
+        ys = [rng.randrange(0, 1 << 256) for _ in range(n)]
+        A = jnp.asarray(np.stack([F.int_to_limbs(x) for x in xs]))
+        B = jnp.asarray(np.stack([F.int_to_limbs(y) for y in ys]))
+        return xs, ys, A, B
+
+    def _assert_mod_eq(self, got, expect_ints):
+        got_ints = F.limbs_to_int_batch(np.asarray(got))
+        arr = np.asarray(got)
+        assert arr.min() >= 0 and arr.max() <= F.MASK  # loose-reduction invariant
+        assert [g % F.P_INT for g in got_ints] == [e % F.P_INT for e in expect_ints]
+
+    def test_add_sub_mul(self):
+        xs, ys, A, B = self._rand_pairs()
+        self._assert_mod_eq(F.add(A, B), [x + y for x, y in zip(xs, ys)])
+        self._assert_mod_eq(F.sub(A, B), [x - y for x, y in zip(xs, ys)])
+        self._assert_mod_eq(F.mul(A, B), [x * y for x, y in zip(xs, ys)])
+        self._assert_mod_eq(F.square(A), [x * x for x in xs])
+        self._assert_mod_eq(F.neg(A), [-x for x in xs])
+
+    def test_pow_invert_canonical(self):
+        xs, _, A, _ = self._rand_pairs(n=4, seed=2)
+        p = F.P_INT
+        self._assert_mod_eq(F.invert(A), [pow(x % p, p - 2, p) for x in xs])
+        self._assert_mod_eq(F.pow_p58(A), [pow(x % p, (p - 5) // 8, p) for x in xs])
+        can = F.limbs_to_int_batch(np.asarray(F.canonical(A)))
+        assert can == [x % p for x in xs]
+
+    def test_edge_values(self):
+        # 0, 1, p-1, p, 2p (aliases of 0), 2^256-1
+        vals = [0, 1, F.P_INT - 1, F.P_INT, 2 * F.P_INT, (1 << 256) - 1]
+        A = jnp.asarray(np.stack([F.int_to_limbs(v) for v in vals]))
+        can = F.limbs_to_int_batch(np.asarray(F.canonical(A)))
+        assert can == [v % F.P_INT for v in vals]
+        self._assert_mod_eq(F.mul(A, A), [v * v for v in vals])
+
+
+class TestBatchVerify:
+    """One compiled bucket (16) exercising the full valid/forged matrix."""
+
+    def _mixed_batch(self):
+        kps = [generate_keypair() for _ in range(6)]
+        items, expect = [], []
+        for i, kp in enumerate(kps):
+            m = f"txn-{i}".encode() * (i + 1)  # varying message lengths
+            items.append(VerifyItem(kp.public_key, m, kp.sign(m)))
+            expect.append(True)
+        # forged: signature over a different message
+        items.append(VerifyItem(kps[0].public_key, b"evil", kps[0].sign(b"good")))
+        expect.append(False)
+        # bit-flipped R
+        s = bytearray(kps[1].sign(b"x"))
+        s[3] ^= 1
+        items.append(VerifyItem(kps[1].public_key, b"x", bytes(s)))
+        expect.append(False)
+        # bit-flipped S
+        s = bytearray(kps[2].sign(b"x2"))
+        s[40] ^= 1
+        items.append(VerifyItem(kps[2].public_key, b"x2", bytes(s)))
+        expect.append(False)
+        # signed by a different key
+        items.append(VerifyItem(kps[3].public_key, b"y", kps[4].sign(b"y")))
+        expect.append(False)
+        # non-canonical pubkey encoding (y >= p)
+        items.append(VerifyItem(b"\xff" * 32, b"z", kps[0].sign(b"z")))
+        expect.append(False)
+        # scalar out of range (S >= L)
+        sig = bytearray(kps[5].sign(b"w"))
+        sig[32:] = b"\xff" * 31 + b"\x0f"
+        items.append(VerifyItem(kps[5].public_key, b"w", bytes(sig)))
+        expect.append(False)
+        # truncated key / signature
+        items.append(VerifyItem(b"\x01" * 31, b"t", kps[0].sign(b"t")))
+        expect.append(False)
+        items.append(VerifyItem(kps[0].public_key, b"t", b"\x02" * 63))
+        expect.append(False)
+        # empty message
+        items.append(VerifyItem(kps[0].public_key, b"", kps[0].sign(b"")))
+        expect.append(True)
+        return items, expect
+
+    def test_matches_cpu_path(self):
+        items, expect = self._mixed_batch()
+        got = BV.verify_batch(items)
+        cpu = [
+            cpu_verify(it.public_key, bytes(it.message), bytes(it.signature))
+            for it in items
+        ]
+        assert got == expect
+        assert got == cpu
+
+    def test_empty_batch(self):
+        assert BV.verify_batch([]) == []
+
+    def test_backend_plugs_into_spi(self):
+        backend = BV.JaxBatchBackend()
+        kp = generate_keypair()
+        items = [VerifyItem(kp.public_key, b"m", kp.sign(b"m"))]
+        assert list(backend(items)) == [True]

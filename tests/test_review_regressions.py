@@ -133,3 +133,141 @@ def test_timer_memory_bounded():
     assert len(t.samples) == 16
     assert t.count == 1000
     assert t.snapshot()["count"] == 1000
+
+
+def test_duplicate_key_transaction_cannot_inflate_quorum():
+    # rf=4 (quorum 3): a txn repeating the same key must not let 2 servers'
+    # grants count as 4 — one vote per (key, server) in Write2 coalescing.
+    from mochi_tpu.protocol import Grant, MultiGrant, Status
+
+    cfg = ClusterConfig.build(
+        {f"server-{i}": f"127.0.0.1:{8001 + i}" for i in range(4)}, rf=4
+    )
+    key = "dup-key"
+    in_set = cfg.replica_set_for_key(key)
+    txn = Transaction(
+        (Operation(Action.WRITE, key, b"evil"), Operation(Action.WRITE, key, b"evil"))
+    )
+    h = transaction_hash(txn)
+    grants = {
+        sid: MultiGrant(
+            grants={key: Grant(key, 5, 1, h, Status.OK)},
+            client_id="attacker",
+            server_id=sid,
+        )
+        for sid in in_set[:2]  # only 2 distinct servers < quorum 3
+    }
+    victim = DataStore(in_set[0], cfg)
+    result = victim.process_write2(Write2ToServer(WriteCertificate(grants), txn))
+    assert isinstance(result, RequestFailedFromServer)
+    assert result.fail_type == FailType.BAD_CERTIFICATE
+    assert victim.data.get(key) is None or victim.data[key].value != b"evil"
+
+
+def test_read_tally_ignores_out_of_set_servers():
+    # 10 servers, rf=4: 4 colluding servers OUTSIDE the key's replica set
+    # answer OK with a forged value while only 3 in-set servers respond
+    # honestly.  The client must take the honest 3 (== quorum), not the
+    # forged 4.
+    from mochi_tpu.client.client import MochiDBClient
+    from mochi_tpu.protocol import OperationResult, ReadFromServer, Status, TransactionResult
+
+    cfg = ClusterConfig.build(
+        {f"server-{i}": f"127.0.0.1:{8001 + i}" for i in range(10)}, rf=4
+    )
+    key = "oos-read-key"
+    in_set = cfg.replica_set_for_key(key)
+    out_set = sorted(set(cfg.servers) - set(in_set))[:4]
+    client = MochiDBClient(cfg)
+    txn = TransactionBuilder().read(key).build()
+
+    async def fake_fan_out(transaction, make_payload):
+        payload = make_payload()
+        nonce = payload.nonce
+        honest = TransactionResult((OperationResult(b"good", None, True, Status.OK),))
+        forged = TransactionResult((OperationResult(b"evil", None, True, Status.OK),))
+        resp = {}
+        for sid in in_set[:3]:
+            resp[sid] = ReadFromServer(honest, nonce, "r")
+        for sid in out_set:
+            resp[sid] = ReadFromServer(forged, nonce, "r")
+        return resp
+
+    client._fan_out = fake_fan_out
+    result = run_return(client.execute_read_transaction(txn))
+    assert result.operations[0].value == b"good"
+
+
+def run_return(coro):
+    return asyncio.run(asyncio.wait_for(coro, timeout=60))
+
+
+def test_write_succeeds_despite_one_refusing_replica():
+    # BFT liveness: one always-refusing replica (f=1, rf=4) must not block
+    # writes when the other 3 (== quorum) grant consistently.
+    from mochi_tpu.protocol import Grant, MultiGrant, Status, Write1RefusedFromServer
+
+    async def main():
+        async with VirtualCluster(4, rf=4) as vc:
+            byz = vc.replicas[0]
+
+            def always_refuse(req):
+                mg = MultiGrant(
+                    grants={
+                        op.key: Grant(op.key, 0, 1, req.transaction_hash, Status.REFUSED)
+                        for op in req.transaction.operations
+                    },
+                    client_id=req.client_id,
+                    server_id=byz.server_id,
+                )
+                return Write1RefusedFromServer(mg, {}, req.client_id)
+
+            byz.store.process_write1 = always_refuse
+            client = vc.client()
+            await client.execute_write_transaction(
+                TransactionBuilder().write("live-k", "live-v").build()
+            )
+            r = await client.execute_read_transaction(
+                TransactionBuilder().read("live-k").build()
+            )
+            assert r.operations[0].value == b"live-v"
+
+    run(main())
+
+
+def test_write_succeeds_despite_one_skewed_timestamp_replica():
+    # A replica granting at a skewed epoch must not stall writes: the client
+    # picks the majority-timestamp subset.
+    async def main():
+        async with VirtualCluster(4, rf=4) as vc:
+            byz = vc.replicas[0]
+            orig = byz.store.process_write1
+
+            def skewed(req):
+                resp = orig(req)
+                from mochi_tpu.protocol import Write1OkFromServer as Ok
+
+                if isinstance(resp, Ok):
+                    from dataclasses import replace as dc_replace
+
+                    mg = resp.multi_grant
+                    skewed_grants = {
+                        k: dc_replace(g, timestamp=g.timestamp + 5000)
+                        for k, g in mg.grants.items()
+                    }
+                    new_mg = dc_replace(mg, grants=skewed_grants)
+                    new_mg = byz._sign_multigrant(new_mg) if hasattr(byz, "_sign_multigrant") else new_mg
+                    return Ok(new_mg, resp.current_certificates)
+                return resp
+
+            byz.store.process_write1 = skewed
+            client = vc.client()
+            await client.execute_write_transaction(
+                TransactionBuilder().write("skew-k", "skew-v").build()
+            )
+            r = await client.execute_read_transaction(
+                TransactionBuilder().read("skew-k").build()
+            )
+            assert r.operations[0].value == b"skew-v"
+
+    run(main())
