@@ -1,0 +1,79 @@
+"""Headline benchmark: batched Ed25519 verify throughput on the JAX device.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The metric is device signature-verification throughput (sigs/sec) on the
+north-star batch size (BASELINE.json config 2 range).  ``vs_baseline`` is the
+speedup over the reference-analog CPU path measured in the same run — one
+OpenSSL (via ``cryptography``) Ed25519 verify per signature on this host,
+single-thread, the stand-in for the reference's intended BouncyCastle
+verifier (the reference itself never signs: ``MochiProtocol.proto:123`` TODO,
+SURVEY.md preamble).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+
+    from mochi_tpu.crypto import batch_verify, keys
+    from mochi_tpu.crypto.curve import verify_prepared
+    from mochi_tpu.verifier.spi import VerifyItem
+
+    batch = 4096
+    rng = np.random.default_rng(244)
+
+    items = []
+    for i in range(batch):
+        kp = keys.keypair_from_seed(rng.bytes(32))
+        msg = b"bench message %d" % i + rng.bytes(32)
+        items.append(VerifyItem(kp.public_key, msg, kp.sign(msg)))
+
+    y_a, sign_a, y_r, sign_r, s_bits, h_bits, pre_ok = batch_verify.prepare(items)
+    assert pre_ok.all()
+    dev = jax.devices()[0]
+    args = tuple(
+        jax.device_put(a, dev) for a in (y_a, sign_a, y_r, sign_r, s_bits, h_bits)
+    )
+    fn = jax.jit(verify_prepared)
+
+    # warmup / compile
+    out = jax.block_until_ready(fn(*args))
+    assert np.asarray(out).all()
+
+    iters = 5
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t1 = time.perf_counter()
+    device_sigs_per_sec = batch * iters / (t1 - t0)
+
+    # CPU baseline: sequential OpenSSL verifies (sampled, extrapolated)
+    sample = items[:256]
+    t0 = time.perf_counter()
+    for it in sample:
+        assert keys.verify(it.public_key, it.message, it.signature)
+    t1 = time.perf_counter()
+    cpu_sigs_per_sec = len(sample) / (t1 - t0)
+
+    print(
+        json.dumps(
+            {
+                "metric": "ed25519_batch_verify_throughput",
+                "value": round(device_sigs_per_sec, 1),
+                "unit": "sigs/sec",
+                "vs_baseline": round(device_sigs_per_sec / cpu_sigs_per_sec, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -93,18 +93,23 @@ _verify_jit = jax.jit(curve.verify_prepared)
 
 
 def verify_batch(
-    items: Sequence[VerifyItem], device: Optional[jax.Device] = None
+    items: Sequence[VerifyItem],
+    device: Optional[jax.Device] = None,
+    bucket: Optional[int] = None,
 ) -> List[bool]:
     """Verify a batch of Ed25519 signatures on the default JAX device.
 
     Returns a python bool list (the SPI bitmap).  Invalid encodings are
     rejected on host; padding lanes carry pre_ok=False and are sliced away.
+    ``bucket`` forces a specific padded size (callers that know which program
+    shapes are already compiled use it to avoid a fresh compile).
     """
     if not items:
         return []
     y_a, sign_a, y_r, sign_r, s_bits, h_bits, pre_ok = prepare(items)
     n = len(items)
-    m = _bucket_size(n)
+    m = _bucket_size(n) if bucket is None else bucket
+    assert m >= n
     if m != n:
         pad = ((0, m - n), (0, 0))
         y_a = np.pad(y_a, pad)
@@ -138,6 +143,7 @@ class JaxBatchBackend:
         self.device = device
         self._ready: set[int] = set()
         self._compiling: set[int] = set()
+        self._failed: set[int] = set()
         self._lock = threading.Lock()
 
     def warmup(self, batch_sizes: Sequence[int]) -> None:
@@ -155,8 +161,14 @@ class JaxBatchBackend:
                 verify_batch(items, device=self.device)
                 with self._lock:
                     self._ready.add(bucket)
-            except Exception:  # pragma: no cover - diagnostics only
-                pass
+            except Exception:
+                LOG.exception(
+                    "background compile of verify bucket %d failed; "
+                    "bucket disabled (batches keep chunking at smaller sizes)",
+                    bucket,
+                )
+                with self._lock:
+                    self._failed.add(bucket)
             finally:
                 with self._lock:
                     self._compiling.discard(bucket)
@@ -167,13 +179,16 @@ class JaxBatchBackend:
         bucket = _bucket_size(len(items))
         with self._lock:
             ready_now = bucket in self._ready
-            largest_ready = max(self._ready, default=0)
-            if not ready_now and largest_ready and bucket not in self._compiling:
+            ready = sorted(self._ready)
+            schedule = (
+                not ready_now
+                and bool(ready)
+                and bucket not in self._compiling
+                and bucket not in self._failed
+            )
+            if schedule:
                 self._compiling.add(bucket)
-                schedule = True
-            else:
-                schedule = False
-        if ready_now or not largest_ready:
+        if ready_now or not ready:
             # Bucket compiled, or nothing compiled yet (first ever call):
             # run directly (the latter eats one synchronous compile — servers
             # avoid it via boot-time warmup).
@@ -183,9 +198,15 @@ class JaxBatchBackend:
             return out
         if schedule:
             self._compile_in_background(bucket)
+        # Serve via already-compiled shapes only: chunk at the largest ready
+        # bucket and pad each chunk up to the smallest ready bucket that fits,
+        # so no chunk can trigger a synchronous compile.
+        largest_ready = ready[-1]
         out: List[bool] = []
         for i in range(0, len(items), largest_ready):
-            out.extend(verify_batch(items[i : i + largest_ready], device=self.device))
+            chunk = items[i : i + largest_ready]
+            target = next(b for b in ready if b >= len(chunk))
+            out.extend(verify_batch(chunk, device=self.device, bucket=target))
         return out
 
 
@@ -196,9 +217,3 @@ def _dummy_items(n: int) -> List[VerifyItem]:
     msg = b"mochi-tpu warmup"
     sig = kp.sign(msg)
     return [VerifyItem(kp.public_key, msg, sig)] * n
-
-
-def warmup(batch_sizes: Sequence[int] = (MIN_BUCKET,)) -> None:
-    """Pre-compile the verify program for the given bucket sizes."""
-    for n in batch_sizes:
-        verify_batch(_dummy_items(_bucket_size(n)))

@@ -54,8 +54,33 @@ def sign(private_seed: bytes, message: bytes) -> bytes:
     return Ed25519PrivateKey.from_private_bytes(private_seed).sign(message)
 
 
+# Strict RFC 8032 canonical-encoding prechecks.  OpenSSL's ref10 decode
+# accepts a handful of non-canonical point encodings (y >= p reduced mod p);
+# the TPU path rejects them.  For BFT safety every replica must reach the
+# SAME verdict on the same bytes regardless of which backend it runs, so the
+# CPU path applies the identical strict prechecks before OpenSSL.
+_P = (1 << 255) - 19
+_L = (1 << 252) + 27742317777372353535851937790883648493
+
+
+def _canonical(public_key: bytes, signature: bytes) -> bool:
+    if len(public_key) != 32 or len(signature) != 64:
+        return False
+    y_a = int.from_bytes(public_key, "little") & ((1 << 255) - 1)
+    y_r = int.from_bytes(signature[:32], "little") & ((1 << 255) - 1)
+    s = int.from_bytes(signature[32:], "little")
+    return y_a < _P and y_r < _P and s < _L
+
+
 def verify(public_key: bytes, message: bytes, signature: bytes) -> bool:
-    """Single-signature CPU verify; returns False on any malformed input."""
+    """Single-signature CPU verify; returns False on any malformed input.
+
+    Verdict is bit-for-bit identical to the TPU batch path
+    (:mod:`mochi_tpu.crypto.batch_verify`): strict canonical-encoding
+    prechecks, then OpenSSL's cofactorless check.
+    """
+    if not _canonical(public_key, signature):
+        return False
     try:
         Ed25519PublicKey.from_public_bytes(public_key).verify(signature, message)
         return True

@@ -1,0 +1,14 @@
+"""Multi-chip parallelism: mesh construction + sharded verify/tally.
+
+TPU-native analog of the reference's parallelism axes (SURVEY.md §2.9):
+replica fan-out -> the batch dimension of the vmapped verifier; token-ring
+sharding -> ``shard_map`` over a ``jax.sharding.Mesh`` with XLA collectives
+over ICI (BASELINE.json config 5).
+"""
+
+from .sharded import (  # noqa: F401
+    make_mesh,
+    make_quorum_step,
+    make_sharded_verify,
+    pad_to_multiple,
+)

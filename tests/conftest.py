@@ -2,8 +2,12 @@
 
 Tests never require real TPU hardware; sharding tests exercise
 ``jax.sharding.Mesh`` semantics over 8 virtual CPU devices
-(``--xla_force_host_platform_device_count=8``).  Must run before any jax
-import, hence environment mutation at conftest import time.
+(``--xla_force_host_platform_device_count=8``).
+
+The axon TPU plugin (when present) registers itself at interpreter start via
+sitecustomize and force-sets ``jax_platforms=axon,cpu``, overriding the
+``JAX_PLATFORMS`` env var — so env mutation alone is not enough; we override
+the config knob back to plain ``cpu`` before any backend initializes.
 """
 
 import os
@@ -12,3 +16,7 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402  (after env mutation, before any backend init)
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,84 @@
+"""Sharded verify + quorum tally over an 8-device virtual CPU mesh.
+
+Exercises the multi-chip path of BASELINE.json config 5 the way the driver's
+``dryrun_multichip`` does: real ``jax.sharding.Mesh``, ``shard_map``, and a
+cross-device ``psum`` for the 2f+1 tally.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from mochi_tpu.crypto.batch_verify import prepare
+from mochi_tpu.crypto.keys import keypair_from_seed
+from mochi_tpu.parallel import (
+    make_mesh,
+    make_quorum_step,
+    make_sharded_verify,
+    pad_to_multiple,
+)
+from mochi_tpu.verifier.spi import VerifyItem
+
+
+def _signed_items(n, forge=()):
+    items = []
+    for i in range(n):
+        kp = keypair_from_seed(bytes([(i + 7) % 251] * 32))
+        msg = b"parallel test %d" % i
+        sig = bytearray(kp.sign(msg))
+        if i in forge:
+            sig[0] ^= 0xFF
+        items.append(VerifyItem(kp.public_key, msg, bytes(sig)))
+    return items
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) >= 8, "conftest must force 8 virtual devices"
+    return make_mesh(8)
+
+
+def test_sharded_verify_matches_expected(mesh):
+    items = _signed_items(16, forge={3, 10})
+    y_a, sign_a, y_r, sign_r, s_bits, h_bits, pre_ok = prepare(items)
+    assert pre_ok.all()
+    verify = make_sharded_verify(mesh)
+    bitmap = np.asarray(verify(y_a, sign_a, y_r, sign_r, s_bits, h_bits))
+    expect = np.ones(16, dtype=bool)
+    expect[[3, 10]] = False
+    assert (bitmap == expect).all()
+
+
+def test_quorum_step_tally_and_commit(mesh):
+    # 4 quorum slots x 4 votes each; forge one vote in slot 1 and three in
+    # slot 2 -> with threshold 3 slots {0,1,3} commit, slot 2 does not.
+    n, n_groups = 16, 4
+    group_ids = (np.arange(n, dtype=np.int32) % n_groups).astype(np.int32)
+    # slot = i % 4: forging items 1 (slot 1), 2, 6, 10 (slot 2)
+    items = _signed_items(n, forge={1, 2, 6, 10})
+    tensors = prepare(items)[:6]
+    step = make_quorum_step(mesh, n_groups)
+    bitmap, counts, committed = (
+        np.asarray(x) for x in step(*tensors, group_ids, np.int32(3))
+    )
+    assert (counts == np.array([4, 3, 1, 4])).all()
+    assert (committed == np.array([True, True, False, True])).all()
+    assert bitmap.sum() == 12
+
+
+def test_pad_to_multiple_dead_groups(mesh):
+    n, n_groups = 10, 3
+    items = _signed_items(n)
+    tensors = prepare(items)[:6]
+    group_ids = (np.arange(n, dtype=np.int32) % n_groups).astype(np.int32)
+    arrays, m = pad_to_multiple(tuple(tensors) + (group_ids,), n, 8, dead_group=n_groups)
+    assert m == 16
+    step = make_quorum_step(mesh, n_groups + 1)
+    bitmap, counts, committed = (
+        np.asarray(x) for x in step(*arrays[:6], arrays[6], np.int32(4))
+    )
+    # padded lanes must all fail verification and tally only into the dead slot
+    assert bitmap[:n].all() and not bitmap[n:].any()
+    assert (counts[:n_groups] == np.bincount(group_ids, minlength=n_groups)).all()
+    assert counts[n_groups] == 0

@@ -1,0 +1,85 @@
+"""Regressions from review: CPU/TPU verdict parity + batcher chunk safety.
+
+For BFT safety every replica must reach the SAME verdict on the same bytes
+regardless of verify backend; divergence lets an adversary split honest
+replicas' quorums (review finding on non-canonical encodings).
+"""
+
+import numpy as np
+
+from mochi_tpu.crypto import batch_verify, keys
+from mochi_tpu.verifier.spi import VerifyItem
+
+P = (1 << 255) - 19
+L = (1 << 252) + 27742317777372353535851937790883648493
+
+
+def test_non_canonical_pubkey_rejected_on_both_paths():
+    # Non-canonical identity encoding: y = p+1 ≡ 1 (the identity point),
+    # R = identity, S = 0 satisfies OpenSSL's decode-mod-p check but MUST be
+    # rejected identically everywhere.
+    pub = (P + 1).to_bytes(32, "little")
+    sig = (1).to_bytes(32, "little") + (0).to_bytes(32, "little")
+    msg = b"split-brain attempt"
+    assert keys.verify(pub, msg, sig) is False
+    assert batch_verify.verify_batch([VerifyItem(pub, msg, sig)]) == [False]
+
+
+def test_non_canonical_r_and_s_rejected_on_both_paths():
+    kp = keys.generate_keypair()
+    msg = b"hello"
+    sig = bytearray(kp.sign(msg))
+    # S >= L
+    bad_s = sig[:32] + (L).to_bytes(32, "little")
+    assert keys.verify(kp.public_key, msg, bytes(bad_s)) is False
+    assert batch_verify.verify_batch([VerifyItem(kp.public_key, msg, bytes(bad_s))]) == [False]
+    # R with y >= p
+    bad_r = (P + 3).to_bytes(32, "little") + sig[32:]
+    assert keys.verify(kp.public_key, msg, bytes(bad_r)) is False
+    assert batch_verify.verify_batch([VerifyItem(kp.public_key, msg, bytes(bad_r))]) == [False]
+
+
+def test_valid_signatures_still_pass_both_paths():
+    kp = keys.generate_keypair()
+    msg = b"canonical"
+    sig = kp.sign(msg)
+    assert keys.verify(kp.public_key, msg, sig) is True
+    assert batch_verify.verify_batch([VerifyItem(kp.public_key, msg, sig)]) == [True]
+
+
+def test_backend_chunks_use_only_ready_buckets(monkeypatch):
+    """A batch whose own bucket isn't compiled must be served only through
+    already-ready program shapes (no synchronous compile on the serving path)."""
+    backend = batch_verify.JaxBatchBackend()
+    backend._ready = {16, 128}
+    # mark bucket 64 as already compiling so no background warmup thread is
+    # spawned — we only want to observe the serving path's launches
+    backend._compiling = {64}
+
+    used_buckets = []
+    real = batch_verify.verify_batch
+
+    def spy(items, device=None, bucket=None):
+        used_buckets.append(bucket if bucket is not None else batch_verify._bucket_size(len(items)))
+        return real(items, device=device, bucket=bucket)
+
+    monkeypatch.setattr(batch_verify, "verify_batch", spy)
+    kp = keys.generate_keypair()
+    msg = b"chunk"
+    items = [VerifyItem(kp.public_key, msg, kp.sign(msg))] * 40
+    out = backend(items)
+    assert list(out) == [True] * 40
+    # bucket(40)=64 is not ready: every launched shape must be in {16, 128}
+    assert used_buckets and all(b in (16, 128) for b in used_buckets)
+
+
+def test_failed_bucket_not_rescheduled():
+    backend = batch_verify.JaxBatchBackend()
+    backend._ready = {16}
+    backend._failed = {64}
+    kp = keys.generate_keypair()
+    msg = b"x"
+    items = [VerifyItem(kp.public_key, msg, kp.sign(msg))] * 40
+    out = backend(items)
+    assert list(out) == [True] * 40
+    assert 64 not in backend._compiling
