@@ -1,0 +1,29 @@
+# mochi-tpu replica image (analog of the reference's Dockerfile_server,
+# which exposed 8080 HTTP + 8081 protocol and read CLUSTER_CONFIG /
+# CLUSTER_CURRENT_SERVER from the environment — SURVEY.md §2.8).
+#
+# Build:  docker build -t mochi-tpu .
+# Run:    docker run -e CLUSTER_CONFIG=/config/cluster_config.json \
+#                    -e CLUSTER_CURRENT_SERVER=server-0 \
+#                    -e SEED_FILE=/config/server-0.seed \
+#                    -v $PWD/cluster:/config -p 8101:8101 -p 9101:9101 mochi-tpu
+FROM python:3.12-slim
+
+RUN apt-get update && apt-get install -y --no-install-recommends gcc libc6-dev \
+    && rm -rf /var/lib/apt/lists/*
+RUN pip install --no-cache-dir jax cryptography numpy
+
+WORKDIR /app
+COPY mochi_tpu ./mochi_tpu
+ENV PYTHONPATH=/app
+
+# protocol port + admin port
+EXPOSE 8101 9101
+
+CMD python -m mochi_tpu.server \
+      --config "${CLUSTER_CONFIG}" \
+      --server-id "${CLUSTER_CURRENT_SERVER}" \
+      --seed-file "${SEED_FILE}" \
+      --host 0.0.0.0 \
+      --admin-port "${ADMIN_PORT:-9101}" \
+      --verifier "${MOCHI_VERIFIER:-cpu}"

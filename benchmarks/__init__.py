@@ -1,0 +1,8 @@
+"""Benchmark harness for the five BASELINE.json configs.
+
+Each module exposes ``run(**kwargs) -> dict`` returning one JSON-able record
+``{"metric", "value", "unit", ...}``; ``run_all.py`` drives them and prints
+one JSON line per config.  The reference ships no benchmark harness at all —
+its only numbers are the paper's WAN table (BASELINE.md); these harnesses
+produce the new framework's measurements on the same workload shapes.
+"""

@@ -1,0 +1,85 @@
+"""Config 1: 5-replica local cluster, signed PUT/GET, f=1.
+
+The BASELINE.md workload shape (5 concurrent clients, write -> read-verify ->
+delete sweeps over disjoint keys — the reference's
+``testWriteOperationConcurrentStressTest``, SURVEY.md §6) on an in-process
+virtual cluster with real loopback TCP and full Ed25519 signing/verification.
+Reports ops/sec plus read/write latency percentiles to compare against the
+reference's WAN table (which had ~13 ms RTT; loopback removes the WAN leg so
+the comparable number is protocol+crypto overhead).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Dict, List
+
+
+def _pct(samples: List[float], q: float) -> float:
+    if not samples:
+        return float("nan")
+    s = sorted(samples)
+    idx = min(len(s) - 1, int(q * len(s)))
+    return s[idx]
+
+
+async def _run(n_clients: int, keys_per_client: int, sweeps: int) -> Dict:
+    from mochi_tpu.client.txn import TransactionBuilder
+    from mochi_tpu.testing.virtual_cluster import VirtualCluster
+
+    async with VirtualCluster(5, rf=4) as vc:
+        read_lat: List[float] = []
+        write_lat: List[float] = []
+        ops = 0
+
+        async def worker(ci: int):
+            nonlocal ops
+            client = vc.client()
+            for s in range(sweeps):
+                for k in range(keys_per_client):
+                    key = f"bench-{ci}-{k}"
+                    val = f"v{s}".encode()
+                    t0 = time.perf_counter()
+                    await client.execute_write_transaction(
+                        TransactionBuilder().write(key, val).build()
+                    )
+                    write_lat.append(time.perf_counter() - t0)
+                    t0 = time.perf_counter()
+                    res = await client.execute_read_transaction(
+                        TransactionBuilder().read(key).build()
+                    )
+                    read_lat.append(time.perf_counter() - t0)
+                    assert res.operations[0].value == val
+                    t0 = time.perf_counter()
+                    await client.execute_write_transaction(
+                        TransactionBuilder().delete(key).build()
+                    )
+                    write_lat.append(time.perf_counter() - t0)
+                    ops += 3
+
+        t0 = time.perf_counter()
+        await asyncio.gather(*[worker(i) for i in range(n_clients)])
+        wall = time.perf_counter() - t0
+
+    return {
+        "metric": "signed_txn_throughput_5replica_f1",
+        "value": round(ops / wall, 1),
+        "unit": "txns/sec",
+        "read_p50_ms": round(_pct(read_lat, 0.50) * 1e3, 2),
+        "read_p95_ms": round(_pct(read_lat, 0.95) * 1e3, 2),
+        "write_p50_ms": round(_pct(write_lat, 0.50) * 1e3, 2),
+        "write_p95_ms": round(_pct(write_lat, 0.95) * 1e3, 2),
+        "ops": ops,
+        "wall_s": round(wall, 2),
+    }
+
+
+def run(n_clients: int = 5, keys_per_client: int = 8, sweeps: int = 2) -> Dict:
+    return asyncio.run(_run(n_clients, keys_per_client, sweeps))
+
+
+if __name__ == "__main__":
+    import json
+
+    print(json.dumps(run()))

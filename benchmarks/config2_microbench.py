@@ -1,0 +1,68 @@
+"""Config 2: Ed25519 batch-verify microbench, 1k-64k msgs/batch.
+
+Device throughput vs batch size, plus the same-host single-thread
+CPU/OpenSSL baseline (the reference-analog BouncyCastle path) — the measured
+denominator BASELINE.json's ">=100k ops/s, <5% CPU" targets need
+(SURVEY.md §7 "no reference crypto numbers exist").
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List
+
+
+def run(batch_sizes=(1024, 4096, 16384, 65536), iters: int = 3) -> Dict:
+    import jax
+
+    from mochi_tpu.crypto import batch_verify, keys
+    from mochi_tpu.crypto.curve import verify_prepared
+    from mochi_tpu.verifier.spi import VerifyItem
+
+    dev = jax.devices()[0]
+    fn = jax.jit(verify_prepared)
+
+    # one keypair, distinct messages (hashing happens host-side in prepare)
+    kp = keys.generate_keypair()
+
+    points: List[Dict] = []
+    items: List[VerifyItem] = []
+    for b in batch_sizes:
+        items = []
+        for i in range(b):
+            msg = b"micro %d" % i
+            items.append(VerifyItem(kp.public_key, msg, kp.sign(msg)))
+        prep = batch_verify.prepare(items)
+        args = tuple(jax.device_put(a, dev) for a in prep[:6])
+        out = jax.block_until_ready(fn(*args))  # compile + warmup
+        best = float("inf")
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            out = jax.block_until_ready(fn(*args))
+            best = min(best, time.perf_counter() - t0)
+        points.append(
+            {"batch": b, "sigs_per_sec": round(b / best, 1), "ms": round(best * 1e3, 2)}
+        )
+
+    # CPU baseline (sampled)
+    sample = items[:512]
+    t0 = time.perf_counter()
+    for it in sample:
+        keys.verify(it.public_key, it.message, it.signature)
+    cpu_rate = len(sample) / (time.perf_counter() - t0)
+
+    peak = max(p["sigs_per_sec"] for p in points)
+    return {
+        "metric": "ed25519_batch_verify_peak_throughput",
+        "value": peak,
+        "unit": "sigs/sec",
+        "vs_baseline": round(peak / cpu_rate, 2),
+        "cpu_openssl_sigs_per_sec": round(cpu_rate, 1),
+        "points": points,
+    }
+
+
+if __name__ == "__main__":
+    import json
+
+    print(json.dumps(run()))

@@ -1,0 +1,71 @@
+"""Config 5: multi-shard batch verify over a device mesh (shard_map/ICI).
+
+BASELINE.json sketched this as "pmap across 4 TPU chips"; the modern
+equivalent is ``shard_map`` over a ``jax.sharding.Mesh``
+(``mochi_tpu.parallel``).  On single-chip hardware this still runs (1-device
+mesh); to exercise a real 8-way mesh on CPU set
+``PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
+XLA_FLAGS=--xla_force_host_platform_device_count=8``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict
+
+
+def run(batch_per_device: int = 2048, n_groups: int = 64, iters: int = 3) -> Dict:
+    import numpy as np
+
+    import jax
+
+    from mochi_tpu.crypto import batch_verify, keys
+    from mochi_tpu.parallel.sharded import (
+        make_mesh,
+        make_quorum_step,
+        pad_to_multiple,
+    )
+    from mochi_tpu.verifier.spi import VerifyItem
+
+    mesh = make_mesh()
+    n_dev = mesh.devices.size
+    b = batch_per_device * n_dev
+
+    kp = keys.generate_keypair()
+    items = []
+    for i in range(b):
+        msg = b"shard %d" % i
+        items.append(VerifyItem(kp.public_key, msg, kp.sign(msg)))
+    prep = batch_verify.prepare(items)
+    group_ids = (np.arange(b, dtype=np.int32) % n_groups).astype(np.int32)
+    arrays, m = pad_to_multiple(
+        tuple(prep[:6]) + (group_ids,), b, n_dev, dead_group=0
+    )
+
+    step = make_quorum_step(mesh, n_groups)
+    thr = np.int32(1)
+    out = jax.block_until_ready(step(*arrays, thr))  # compile
+    bitmap = np.asarray(out[0])
+    assert bitmap[:b].all()
+
+    best = float("inf")
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = jax.block_until_ready(step(*arrays, thr))
+        best = min(best, time.perf_counter() - t0)
+
+    return {
+        "metric": "multichip_sharded_verify_throughput",
+        "value": round(b / best, 1),
+        "unit": "sigs/sec",
+        "devices": n_dev,
+        "platform": jax.devices()[0].platform,
+        "batch_total": b,
+        "ms": round(best * 1e3, 2),
+    }
+
+
+if __name__ == "__main__":
+    import json
+
+    print(json.dumps(run()))

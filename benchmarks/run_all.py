@@ -1,0 +1,40 @@
+"""Run all five BASELINE.json benchmark configs; one JSON line each.
+
+Usage: python -m benchmarks.run_all [config-number ...]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def main(argv) -> None:
+    from . import (  # noqa: WPS347
+        config1_cluster,
+        config2_microbench,
+        config3_ycsb,
+        config4_viewchange,
+        config5_multichip,
+    )
+
+    configs = {
+        "1": config1_cluster,
+        "2": config2_microbench,
+        "3": config3_ycsb,
+        "4": config4_viewchange,
+        "5": config5_multichip,
+    }
+    wanted = argv or list(configs)
+    for key in wanted:
+        mod = configs[str(key)]
+        try:
+            rec = mod.run()
+        except Exception as exc:  # keep the sweep going; record the failure
+            rec = {"metric": mod.__name__, "error": f"{type(exc).__name__}: {exc}"}
+        rec["config"] = str(key)
+        print(json.dumps(rec), flush=True)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -1,0 +1,126 @@
+"""Minimal asyncio HTTP admin server (no external web framework).
+
+Endpoints (reference analog in parens — SURVEY.md §2.8):
+
+* ``GET /json``    — hello record, like the demo REST controller
+  (``controller/MainController.java:15-21``)
+* ``GET /status``  — replica identity, cluster shape, store counters
+* ``GET /metrics`` — ``mochi_tpu.utils.metrics`` snapshot (the reference had
+  client-side Dropwizard timers via JMX only, ``MochiDBClient.java:52-70``;
+  here every replica serves its own)
+* ``GET /``        — static status page (``resources/static/index.html``)
+
+Deliberately HTTP/1.1-subset: GET only, no keep-alive pipelining guarantees,
+JSON bodies.  This is an operator surface, not a data path.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Optional
+
+_PAGE = """<!doctype html>
+<html><head><title>mochi-tpu replica</title>
+<style>
+ body {{ font-family: system-ui, sans-serif; margin: 2rem auto; max-width: 40rem; }}
+ code {{ background: #f0f0f0; padding: 0.1rem 0.3rem; border-radius: 4px; }}
+ li {{ margin: 0.4rem 0; }}
+</style></head>
+<body>
+<h1>mochi-tpu replica: {server_id}</h1>
+<p>BFT transactional KV store, TPU-batched signature verification.</p>
+<ul>
+<li><a href="/status"><code>/status</code></a> — replica + cluster state</li>
+<li><a href="/metrics"><code>/metrics</code></a> — timers and counters</li>
+<li><a href="/json"><code>/json</code></a> — hello record</li>
+</ul>
+</body></html>
+"""
+
+
+class AdminServer:
+    """Serves replica status over HTTP; start()/close() lifecycle."""
+
+    def __init__(self, replica, host: str = "127.0.0.1", port: int = 0):
+        self.replica = replica
+        self.host = host
+        self.port = port
+        self._server: Optional[asyncio.base_events.Server] = None
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(self._serve, self.host, self.port)
+
+    @property
+    def bound_port(self) -> int:
+        assert self._server is not None
+        return self._server.sockets[0].getsockname()[1]
+
+    async def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    # ------------------------------------------------------------ handlers
+
+    def _route(self, path: str):
+        r = self.replica
+        if path == "/json":
+            return 200, "application/json", json.dumps(
+                {"hello": "mochi-tpu", "serverId": r.server_id}
+            )
+        if path == "/status":
+            cfg = r.config
+            return 200, "application/json", json.dumps(
+                {
+                    "server_id": r.server_id,
+                    "port": r.bound_port,
+                    "cluster": {
+                        "n_servers": cfg.n_servers,
+                        "rf": cfg.rf,
+                        "f": cfg.f,
+                        "quorum": cfg.quorum,
+                        "configstamp": cfg.configstamp,
+                        "servers": {s.server_id: s.url for s in cfg.servers.values()},
+                    },
+                    "store": r.store.stats(),
+                    "verifier": type(r.verifier).__name__ if r.verifier else "CpuVerifier",
+                }
+            )
+        if path == "/metrics":
+            return 200, "application/json", json.dumps(r.metrics.snapshot())
+        if path == "/" or path == "/index.html":
+            return 200, "text/html", _PAGE.format(server_id=r.server_id)
+        return 404, "application/json", json.dumps({"error": "not found"})
+
+    async def _serve(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        try:
+            request_line = await asyncio.wait_for(reader.readline(), 10.0)
+            parts = request_line.decode("latin-1").split()
+            if len(parts) < 2 or parts[0] != "GET":
+                status, ctype, body = 405, "application/json", '{"error": "GET only"}'
+            else:
+                # drain headers
+                while True:
+                    line = await asyncio.wait_for(reader.readline(), 10.0)
+                    if line in (b"\r\n", b"\n", b""):
+                        break
+                status, ctype, body = self._route(parts[1].split("?")[0])
+            payload = body.encode()
+            reason = {200: "OK", 404: "Not Found", 405: "Method Not Allowed"}[status]
+            writer.write(
+                f"HTTP/1.1 {status} {reason}\r\n"
+                f"Content-Type: {ctype}\r\n"
+                f"Content-Length: {len(payload)}\r\n"
+                "Connection: close\r\n\r\n".encode() + payload
+            )
+            await writer.drain()
+        except (asyncio.TimeoutError, ConnectionResetError, UnicodeDecodeError):
+            pass
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except Exception:
+                pass

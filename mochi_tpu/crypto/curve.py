@@ -22,6 +22,8 @@ from __future__ import annotations
 
 from typing import NamedTuple, Tuple
 
+import numpy as np
+
 import jax.numpy as jnp
 from jax import lax
 
@@ -160,6 +162,119 @@ def double_scalar_mul(
     return q
 
 
+# --------------------------------------------------------------------------
+# Windowed double-scalar-mul: 4-bit digits.  vs the 1-bit Straus ladder:
+# same 256 doublings but 64+64 windowed additions instead of 256 complete
+# additions, and the base-point additions use a precomputed constant table
+# in Niels form (y+x, y-x, 2dxy) which saves 2 muls per addition.
+# ~3200 field muls/signature vs ~4900 for the 1-bit ladder.
+
+_NIELS_IDENTITY = (1, 1, 0)  # (y+x, y-x, 2dxy) of the neutral element
+
+
+def _py_edwards_add(p, q):
+    """Affine Edwards addition on python ints (host, table precompute only)."""
+    P, D = F.P_INT, F.D_INT
+    x1, y1 = p
+    x2, y2 = q
+    k = D * x1 * x2 * y1 * y2 % P
+    x3 = (x1 * y2 + x2 * y1) * pow(1 + k, P - 2, P) % P
+    y3 = (y1 * y2 + x1 * x2) * pow(1 - k, P - 2, P) % P
+    return (x3, y3)
+
+
+def _basepoint_niels_table() -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """[d]B for d in 0..15 in Niels form, as three (16, NLIMBS) int32 arrays."""
+    b = (F.BX_INT, F.BY_INT)
+    pts = [(0, 1)]  # identity
+    for _ in range(15):
+        pts.append(_py_edwards_add(pts[-1], b))
+    ypx = np.stack([F.int_to_limbs((y + x) % F.P_INT) for x, y in pts])
+    ymx = np.stack([F.int_to_limbs((y - x) % F.P_INT) for x, y in pts])
+    xy2d = np.stack(
+        [F.int_to_limbs(2 * F.D_INT * x * y % F.P_INT) for x, y in pts]
+    )
+    return ypx, ymx, xy2d
+
+
+_B_TAB_YPX, _B_TAB_YMX, _B_TAB_XY2D = _basepoint_niels_table()
+
+
+def madd_niels(
+    p: Point, ypx: jnp.ndarray, ymx: jnp.ndarray, xy2d: jnp.ndarray
+) -> Point:
+    """Mixed addition with a precomputed Niels-form point (madd-2008-hwcd-3).
+
+    7 field muls; complete for the same reason as :func:`add` (a = -1,
+    d non-square), and the identity entry (1, 1, 0) is handled uniformly.
+    """
+    a = F.mul(F.sub(p.y, p.x), ymx)
+    b = F.mul(F.add(p.y, p.x), ypx)
+    c = F.mul(xy2d, p.t)
+    d = F.add(p.z, p.z)
+    e = F.sub(b, a)
+    f = F.sub(d, c)
+    g = F.add(d, c)
+    h = F.add(b, a)
+    return Point(F.mul(e, f), F.mul(g, h), F.mul(f, g), F.mul(e, h))
+
+
+def digits4_from_bits(bits: jnp.ndarray) -> jnp.ndarray:
+    """(..., 256) little-endian bits -> (..., 64) base-16 digits."""
+    w = jnp.asarray([1, 2, 4, 8], dtype=jnp.int32)
+    return jnp.einsum(
+        "...wb,b->...w", bits.reshape(*bits.shape[:-1], 64, 4), w
+    ).astype(jnp.int32)
+
+
+def _small_multiples_table(p: Point) -> list:
+    """[0..15]P in extended coords, per coordinate stacked on axis -2."""
+    pts = [identity(p.x.shape[:-1]), p]
+    for k in range(2, 16):
+        pts.append(double(pts[k // 2]) if k % 2 == 0 else add(pts[k - 1], p))
+    return [jnp.stack(coord, axis=-2) for coord in zip(*pts)]
+
+
+def double_scalar_mul_windowed(
+    s_bits: jnp.ndarray, p_bits: jnp.ndarray, p_point: Point
+) -> Point:
+    """[s]B + [p]P with 4-bit windows, msb-first over 64 windows.
+
+    Per window: 4 doublings, one complete addition from the per-item
+    [0..15]P table (data-dependent gather), one Niels mixed addition from
+    the constant [0..15]B table (shared gather).
+    """
+    batch_shape = s_bits.shape[:-1]
+    s_dig = digits4_from_bits(s_bits)
+    p_dig = digits4_from_bits(p_bits)
+    a_tab = _small_multiples_table(p_point)
+    b_ypx = jnp.asarray(_B_TAB_YPX)
+    b_ymx = jnp.asarray(_B_TAB_YMX)
+    b_xy2d = jnp.asarray(_B_TAB_XY2D)
+
+    def body(i, q):
+        w = 63 - i
+        q = double(double(double(double(q))))
+        pd = p_dig[..., w]
+        entry = Point(
+            *(
+                jnp.take_along_axis(t, pd[..., None, None], axis=-2).squeeze(-2)
+                for t in a_tab
+            )
+        )
+        q = add(q, entry)
+        sd = s_dig[..., w]
+        q = madd_niels(
+            q,
+            jnp.take(b_ypx, sd, axis=0),
+            jnp.take(b_ymx, sd, axis=0),
+            jnp.take(b_xy2d, sd, axis=0),
+        )
+        return q
+
+    return lax.fori_loop(0, 64, body, identity(batch_shape))
+
+
 def verify_prepared(
     y_a: jnp.ndarray,
     sign_a: jnp.ndarray,
@@ -178,7 +293,7 @@ def verify_prepared(
     """
     a_point, ok_a = decompress(y_a, sign_a)
     r_point, ok_r = decompress(y_r, sign_r)
-    q = double_scalar_mul(s_bits, h_bits, negate(a_point))
+    q = double_scalar_mul_windowed(s_bits, h_bits, negate(a_point))
     eq_x = F.eq(q.x, F.mul(r_point.x, q.z))
     eq_y = F.eq(q.y, F.mul(r_point.y, q.z))
     return ok_a & ok_r & eq_x & eq_y

@@ -12,6 +12,7 @@ BASELINE.json — while the TPU batch-verify path lives in
 from __future__ import annotations
 
 from dataclasses import dataclass
+from functools import lru_cache
 
 from cryptography.exceptions import InvalidSignature
 from cryptography.hazmat.primitives.asymmetric.ed25519 import (
@@ -50,8 +51,21 @@ def keypair_from_seed(seed: bytes) -> KeyPair:
     return KeyPair(seed, pub)
 
 
+# Parsing raw bytes into OpenSSL key handles costs as much as the crypto op
+# itself; replicas/clients reuse the same few keys for every message, so the
+# parsed handles are cached (bounded: a cluster touches n_servers + clients).
+@lru_cache(maxsize=4096)
+def _private_key(private_seed: bytes) -> Ed25519PrivateKey:
+    return Ed25519PrivateKey.from_private_bytes(private_seed)
+
+
+@lru_cache(maxsize=65536)
+def _public_key(public_key: bytes) -> Ed25519PublicKey:
+    return Ed25519PublicKey.from_public_bytes(public_key)
+
+
 def sign(private_seed: bytes, message: bytes) -> bytes:
-    return Ed25519PrivateKey.from_private_bytes(private_seed).sign(message)
+    return _private_key(private_seed).sign(message)
 
 
 # Strict RFC 8032 canonical-encoding prechecks.  OpenSSL's ref10 decode
@@ -82,7 +96,7 @@ def verify(public_key: bytes, message: bytes, signature: bytes) -> bool:
     if not _canonical(public_key, signature):
         return False
     try:
-        Ed25519PublicKey.from_public_bytes(public_key).verify(signature, message)
+        _public_key(public_key).verify(signature, message)
         return True
     except (InvalidSignature, ValueError):
         return False

@@ -1,0 +1,95 @@
+"""Native (C) runtime components, lazily built with the system toolchain.
+
+The reference's performance-critical host path is native (Netty's epoll loop,
+JDK MessageDigest intrinsics — SURVEY.md §2.9); the TPU framework keeps its
+Python control plane but moves hot host loops to C extensions:
+
+* ``_mcode`` — the canonical wire/signing codec (mcode.c).
+
+Build model: compiled on first use into this package directory with the
+system compiler (cc/gcc), cached by source mtime; if no toolchain is
+available the callers fall back to the pure-Python implementations, so the
+framework never *requires* the native path — it only gets faster with one.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import logging
+import os
+import subprocess
+import sys
+import sysconfig
+import tempfile
+from types import ModuleType
+from typing import Optional
+
+LOG = logging.getLogger(__name__)
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_cached: Optional[ModuleType] = None
+_build_attempted = False
+
+
+def _so_path() -> str:
+    suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    return os.path.join(_DIR, f"_mcode{suffix}")
+
+
+def _needs_build(so: str, src: str) -> bool:
+    try:
+        return os.path.getmtime(so) < os.path.getmtime(src)
+    except OSError:
+        return True
+
+
+def _build(src: str, so: str) -> bool:
+    cc = os.environ.get("CC", "cc")
+    include = sysconfig.get_paths()["include"]
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_DIR)
+    os.close(fd)
+    cmd = [cc, "-O2", "-shared", "-fPIC", f"-I{include}", src, "-o", tmp]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+        if proc.returncode != 0:
+            LOG.warning("native mcode build failed:\n%s", proc.stderr)
+            os.unlink(tmp)
+            return False
+        os.replace(tmp, so)  # atomic: concurrent builders race benignly
+        return True
+    except (OSError, subprocess.SubprocessError) as exc:
+        LOG.warning("native mcode build unavailable: %s", exc)
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def get_mcode() -> Optional[ModuleType]:
+    """The compiled ``_mcode`` module, building it if needed; None if no
+    toolchain (callers then use the pure-Python codec)."""
+    global _cached, _build_attempted
+    if _cached is not None:
+        return _cached
+    if os.environ.get("MOCHI_NO_NATIVE"):
+        return None
+    src = os.path.join(_DIR, "mcode.c")
+    so = _so_path()
+    if _needs_build(so, src):
+        if _build_attempted:
+            return None
+        _build_attempted = True
+        if not _build(src, so):
+            return None
+    try:
+        spec = importlib.util.spec_from_file_location("mochi_tpu.native._mcode", so)
+        assert spec is not None and spec.loader is not None
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        sys.modules["mochi_tpu.native._mcode"] = mod
+        _cached = mod
+        return mod
+    except Exception:
+        LOG.exception("native mcode failed to load; using pure-Python codec")
+        return None

@@ -91,8 +91,8 @@ def _encode_into(buf: bytearray, value: Any, depth: int) -> None:
         raise TypeError(f"mcode cannot encode {type(value)}")
 
 
-def encode(value: Any) -> bytes:
-    """Canonically encode a structural value to bytes."""
+def _encode_py(value: Any) -> bytes:
+    """Canonically encode a structural value to bytes (pure Python)."""
     buf = bytearray()
     _encode_into(buf, value, 0)
     return bytes(buf)
@@ -171,10 +171,28 @@ class _Reader:
         raise ValueError(f"mcode: unknown tag {tag:#x}")
 
 
-def decode(data: bytes) -> Any:
+def _decode_py(data: bytes) -> Any:
     """Decode bytes produced by :func:`encode`; rejects trailing garbage."""
     reader = _Reader(bytes(data))
     value = reader.read_value()
     if reader.pos != len(reader.data):
         raise ValueError("mcode: trailing bytes after value")
     return value
+
+
+# Prefer the native codec (mochi_tpu/native/mcode.c — bit-identical, ~20x
+# faster; tests/test_codec.py checks the two differentially).  The pure-Python
+# path stays both as fallback and as the readable spec of the format.
+def _bind():
+    try:
+        from ..native import get_mcode
+
+        mod = get_mcode()
+        if mod is not None:
+            return mod.encode, mod.decode
+    except Exception:  # pragma: no cover - import-time safety net
+        pass
+    return _encode_py, _decode_py
+
+
+encode, decode = _bind()

@@ -55,11 +55,20 @@ async def amain(args) -> None:
         port=info.port,
     )
     await replica.start()
+    admin = None
+    if args.admin_port is not None:
+        from ..admin import AdminServer
+
+        admin = AdminServer(replica, host=args.host or "127.0.0.1", port=args.admin_port)
+        await admin.start()
+        logging.info("admin shell on port %s", admin.bound_port)
     logging.info("replica %s serving on %s:%s", args.server_id, replica.rpc.host, replica.bound_port)
     print(f"READY {args.server_id} {replica.bound_port}", flush=True)
     try:
         await asyncio.Event().wait()
     finally:
+        if admin is not None:
+            await admin.close()
         await replica.close()
 
 
@@ -70,6 +79,12 @@ def main(argv=None) -> None:
     parser.add_argument("--seed-file", required=True)
     parser.add_argument("--host", default=None, help="bind host override (e.g. 0.0.0.0)")
     parser.add_argument("--verifier", choices=("cpu", "tpu"), default="cpu")
+    parser.add_argument(
+        "--admin-port",
+        type=int,
+        default=None,
+        help="serve the HTTP admin shell (/status, /metrics) on this port",
+    )
     parser.add_argument("--log-level", default="INFO")
     args = parser.parse_args(argv)
     logging.basicConfig(

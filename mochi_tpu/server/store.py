@@ -163,6 +163,17 @@ class DataStore:
     def owns(self, key: str) -> bool:
         return self.config.owns_key(self.server_id, key)
 
+    def stats(self) -> Dict[str, int]:
+        """Operator-facing counters (served by the admin HTTP shell)."""
+        live = sum(1 for sv in self.data.values() if sv.exists)
+        grants = sum(len(e) for sv in self.data.values() for e in sv.grants.values())
+        return {
+            "keys": len(self.data),
+            "keys_live": live,
+            "config_keys": len(self.data_config),
+            "outstanding_grants": grants,
+        }
+
     # ------------------------------------------------------------------ read
 
     def process_read(self, transaction: Transaction) -> TransactionResult:

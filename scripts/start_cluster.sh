@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# Launch a local mochi-tpu cluster (ops analog of the reference's
+# start_mochi.sh / start_mochi_docker.sh — SURVEY.md §2.8).
+#
+# Usage: scripts/start_cluster.sh [N_SERVERS] [RF] [BASE_PORT] [OUT_DIR]
+set -euo pipefail
+
+N=${1:-5}
+RF=${2:-4}
+BASE_PORT=${3:-8101}
+OUT=${4:-./cluster}
+REPO_DIR=$(cd "$(dirname "$0")/.." && pwd)
+
+export PYTHONPATH="${REPO_DIR}${PYTHONPATH:+:$PYTHONPATH}"
+
+if [ ! -f "$OUT/cluster_config.json" ]; then
+  python -m mochi_tpu.tools.gen_cluster \
+    --out-dir "$OUT" --servers "$N" --rf "$RF" --base-port "$BASE_PORT"
+fi
+
+mkdir -p "$OUT/log"
+PIDS=()
+for i in $(seq 0 $((N - 1))); do
+  python -m mochi_tpu.server \
+    --config "$OUT/cluster_config.json" \
+    --server-id "server-$i" \
+    --seed-file "$OUT/server-$i.seed" \
+    --admin-port $((BASE_PORT + 1000 + i)) \
+    --verifier "${MOCHI_VERIFIER:-cpu}" \
+    >"$OUT/log/server-$i.log" 2>&1 &
+  PIDS+=($!)
+done
+
+trap 'kill "${PIDS[@]}" 2>/dev/null || true' INT TERM
+echo "cluster of $N replicas starting (rf=$RF); logs in $OUT/log/"
+echo "stop with: kill ${PIDS[*]}"
+wait

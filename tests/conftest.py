@@ -20,3 +20,9 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402  (after env mutation, before any backend init)
 
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent compilation cache: the Ed25519 verify program takes minutes to
+# compile on CPU; cache it across test processes/runs.
+_CACHE_DIR = os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
+jax.config.update("jax_compilation_cache_dir", os.path.abspath(_CACHE_DIR))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)

@@ -1,0 +1,52 @@
+"""Admin HTTP shell: status/metrics/json endpoints over a live replica."""
+
+import asyncio
+import json
+import urllib.request
+
+from mochi_tpu.admin import AdminServer
+from mochi_tpu.client.txn import TransactionBuilder
+from mochi_tpu.testing.virtual_cluster import VirtualCluster
+
+
+def _get(port: int, path: str):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=5) as resp:
+        body = resp.read().decode()
+        return resp.status, resp.headers.get("Content-Type"), body
+
+
+def test_admin_endpoints():
+    asyncio.run(asyncio.wait_for(_main(), timeout=60))
+
+
+async def _main():
+    async with VirtualCluster(5, rf=4) as vc:
+        client = vc.client()
+        await client.execute_write_transaction(
+            TransactionBuilder().write("adm-key", b"v").build()
+        )
+        replica = vc.replicas[0]
+        admin = AdminServer(replica, port=0)
+        await admin.start()
+        try:
+            port = admin.bound_port
+            loop = asyncio.get_running_loop()
+
+            status, ctype, body = await loop.run_in_executor(None, _get, port, "/status")
+            assert status == 200 and ctype == "application/json"
+            doc = json.loads(body)
+            assert doc["server_id"] == replica.server_id
+            assert doc["cluster"]["rf"] == 4 and doc["cluster"]["quorum"] == 3
+            assert doc["store"]["keys"] >= 0
+
+            status, _, body = await loop.run_in_executor(None, _get, port, "/metrics")
+            assert status == 200
+            json.loads(body)
+
+            status, _, body = await loop.run_in_executor(None, _get, port, "/json")
+            assert status == 200 and json.loads(body)["hello"] == "mochi-tpu"
+
+            status, ctype, body = await loop.run_in_executor(None, _get, port, "/")
+            assert status == 200 and "text/html" in ctype and replica.server_id in body
+        finally:
+            await admin.close()

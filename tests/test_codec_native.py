@@ -1,0 +1,94 @@
+"""Native (_mcode C extension) vs pure-Python codec: differential parity.
+
+The wire format doubles as the signing format, so the two implementations
+must agree bit-for-bit on encode and verdict-for-verdict on decode errors —
+a native/Python disagreement would let a message verify on one replica and
+fail on another (same BFT-divergence class as the verifier parity tests).
+"""
+
+import random
+import string
+
+import pytest
+
+from mochi_tpu.native import get_mcode
+from mochi_tpu.protocol import codec
+
+native = get_mcode()
+pytestmark = pytest.mark.skipif(native is None, reason="no C toolchain")
+
+
+def _rand_value(rng, depth=0):
+    t = rng.randrange(9 if depth < 3 else 6)
+    if t == 0:
+        return None
+    if t == 1:
+        return rng.choice([True, False])
+    if t == 2:
+        return rng.randrange(0, 1 << 64)
+    if t == 3:
+        return -rng.randrange(1, 1 << 63)
+    if t == 4:
+        return bytes(rng.randrange(0, 40))
+    if t == 5:
+        alphabet = string.printable + "λ中☃"
+        return "".join(rng.choice(alphabet) for _ in range(rng.randrange(0, 20)))
+    if t == 6:
+        return [_rand_value(rng, depth + 1) for _ in range(rng.randrange(0, 6))]
+    return {
+        "".join(rng.choice("abcde中λ") for _ in range(rng.randrange(1, 8))): _rand_value(
+            rng, depth + 1
+        )
+        for _ in range(rng.randrange(0, 6))
+    }
+
+
+def test_encode_bit_identical_fuzz():
+    rng = random.Random(4242)
+    for _ in range(1500):
+        v = _rand_value(rng)
+        e_py = codec._encode_py(v)
+        e_c = native.encode(v)
+        assert e_py == e_c, v
+        assert native.decode(e_c) == codec._decode_py(e_py)
+
+
+def test_decode_error_parity():
+    bad_inputs = [
+        b"",
+        b"\xff",
+        b"\x03",  # truncated varint
+        b"\x05\x05ab",  # truncated bytes
+        b"\x00\x00",  # trailing
+        b"\x08\x01\x03\x01\x00",  # dict key not str
+        b"\x07\xff\xff\xff\xff\x7f",  # list guard
+        b"\x03" + b"\x80" * 10 + b"\x02",  # varint out of 64-bit range
+    ]
+    for bad in bad_inputs:
+        with pytest.raises(ValueError):
+            native.decode(bad)
+        with pytest.raises(ValueError):
+            codec._decode_py(bad)
+
+
+def test_encode_type_error_parity():
+    for v in [2**64, -(2**64) - 1, {1: "x"}, object(), 1.5]:
+        with pytest.raises(TypeError):
+            native.encode(v)
+        with pytest.raises(TypeError):
+            codec._encode_py(v)
+
+
+def test_deep_nesting_guard_parity():
+    v = None
+    for _ in range(40):
+        v = [v]
+    with pytest.raises(ValueError):
+        native.encode(v)
+    with pytest.raises(ValueError):
+        codec._encode_py(v)
+
+
+def test_bound_codec_is_native_when_available():
+    assert codec.encode is native.encode
+    assert codec.decode is native.decode
