@@ -27,10 +27,11 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..cluster.config import ClusterConfig, ServerInfo
 from ..crypto.keys import KeyPair, generate_keypair, verify as cpu_verify
-from ..net.transport import RpcClientPool, fan_out
+from ..net.transport import RpcClientPool, fan_out, new_msg_id
 from ..protocol import (
     Envelope,
     MultiGrant,
+    NudgeSyncToServer,
     Operation,
     Action,
     ReadFromServer,
@@ -262,11 +263,54 @@ class MochiDBClient:
                             f"write refused after {refusals} attempts "
                             f"({len(oks)} grants, quorum {self.config.quorum})"
                         )
+                    # Timestamp splits usually mean some replicas lost state
+                    # (restart: epochs back at 0).  Nudge the laggards to
+                    # resync before retrying (paper's client-initiated
+                    # UptoSpeed, mochiDB.tex:168-169).
+                    await self._nudge_laggards(transaction, oks)
                     await asyncio.sleep(0.001 * (1 + attempt))
                     continue
                 certificate = WriteCertificate({mg.server_id: mg for mg in chosen})
                 return await self._write2(transaction, certificate)
             raise RequestRefused(f"write did not converge in {self.write_attempts} attempts")
+
+    async def _nudge_laggards(
+        self, transaction: Transaction, oks: Sequence[MultiGrant]
+    ) -> None:
+        """Tell replicas whose grant timestamps trail the per-key maximum to
+        pull state from their peers.  Advisory and best-effort: failures are
+        ignored (the retry loop and the replicas' own validation carry the
+        correctness burden)."""
+        behind: Dict[str, set] = {}
+        for op in transaction.operations:
+            ts_by_server = {
+                mg.server_id: g.timestamp
+                for mg in oks
+                if (g := mg.grants.get(op.key)) is not None and g.status == Status.OK
+            }
+            if len(ts_by_server) < 2:
+                continue
+            newest = max(ts_by_server.values())
+            for sid, ts in ts_by_server.items():
+                # An honest laggard's epoch (and thus grant ts) trails by
+                # >= one epoch unit; same-epoch spread is just seed noise.
+                if newest - ts >= SEED_RANGE:
+                    behind.setdefault(sid, set()).add(op.key)
+        if not behind:
+            return
+
+        async def nudge(sid: str, keys: set) -> None:
+            info = self.config.servers.get(sid)
+            if info is None:
+                return
+            msg_id = new_msg_id()
+            env = self._envelope(NudgeSyncToServer(tuple(sorted(keys))), msg_id)
+            try:
+                await self.pool.send_and_receive(info, env, timeout_s=2.0)
+            except Exception:
+                pass
+
+        await asyncio.gather(*(nudge(sid, keys) for sid, keys in behind.items()))
 
     async def _write2(
         self, transaction: Transaction, certificate: WriteCertificate

@@ -60,6 +60,7 @@ class RpcServer:
         self.port = port
         self.handler = handler
         self._server: Optional[asyncio.base_events.Server] = None
+        self._conn_writers: set = set()
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(self._serve_conn, self.host, self.port)
@@ -75,6 +76,7 @@ class RpcServer:
         peer = writer.get_extra_info("peername")
         write_lock = asyncio.Lock()
         tasks: set = set()
+        self._conn_writers.add(writer)
         try:
             while True:
                 frame = await _read_frame(reader)
@@ -91,6 +93,7 @@ class RpcServer:
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass
         finally:
+            self._conn_writers.discard(writer)
             for task in tasks:
                 task.cancel()
             writer.close()
@@ -120,6 +123,11 @@ class RpcServer:
     async def close(self) -> None:
         if self._server is not None:
             self._server.close()
+            # Drop live connections first: since 3.12, Server.wait_closed()
+            # waits for every connection handler to finish, and ours loop
+            # until the peer hangs up.
+            for writer in list(self._conn_writers):
+                writer.close()
             await self._server.wait_closed()
             self._server = None
 

@@ -383,6 +383,98 @@ class HelloFromServer:
 
 
 # --------------------------------------------------------------------------
+# State-transfer / resync (the paper's UptoSpeed, ``mochiDB.tex:168-169`` —
+# declared but never implemented in the reference; SURVEY.md §5 "failure
+# detection").  Trustless by construction: a sync entry carries the full
+# (transaction, write certificate) pair of the last commit, so the receiver
+# validates it through the exact Write2 path (2f+1 signed grants, hash
+# match, staleness check) — a Byzantine peer cannot forge state.
+
+
+@dataclass(frozen=True)
+class SyncEntry:
+    """Last committed state of one object: (key, transaction, certificate)."""
+
+    key: str
+    transaction: Transaction
+    certificate: WriteCertificate
+
+    def to_obj(self) -> Any:
+        return [self.key, self.transaction.to_obj(), self.certificate.to_obj()]
+
+    @classmethod
+    def from_obj(cls, obj: Any) -> "SyncEntry":
+        key, txn, wc = obj
+        return cls(key, Transaction.from_obj(txn), WriteCertificate.from_obj(wc))
+
+
+@dataclass(frozen=True)
+class SyncRequestToServer:
+    """Pull request: give me your committed state for these keys (None = all
+    keys you own).  Pages of ``max_entries``, keys sorted ascending; pass the
+    last key of the previous page as ``after_key`` to continue."""
+
+    keys: Optional[Tuple[str, ...]] = None
+    max_entries: int = 1024
+    after_key: Optional[str] = None
+
+    def to_obj(self) -> Any:
+        return [
+            list(self.keys) if self.keys is not None else None,
+            self.max_entries,
+            self.after_key,
+        ]
+
+    @classmethod
+    def from_obj(cls, obj: Any) -> "SyncRequestToServer":
+        keys, max_entries, after_key = obj
+        return cls(tuple(keys) if keys is not None else None, max_entries, after_key)
+
+
+@dataclass(frozen=True)
+class SyncEntriesFromServer:
+    """Response: committed entries (each independently verifiable)."""
+
+    entries: Tuple[SyncEntry, ...]
+
+    def to_obj(self) -> Any:
+        return [e.to_obj() for e in self.entries]
+
+    @classmethod
+    def from_obj(cls, obj: Any) -> "SyncEntriesFromServer":
+        return cls(tuple(SyncEntry.from_obj(e) for e in obj))
+
+
+@dataclass(frozen=True)
+class NudgeSyncToServer:
+    """Client hint: your grants for these keys lag the quorum — resync.
+    Advisory only (the replica pulls and re-validates from its peers)."""
+
+    keys: Tuple[str, ...]
+
+    def to_obj(self) -> Any:
+        return [list(self.keys)]
+
+    @classmethod
+    def from_obj(cls, obj: Any) -> "NudgeSyncToServer":
+        return cls(tuple(obj[0]))
+
+
+@dataclass(frozen=True)
+class SyncAckFromServer:
+    """Nudge acknowledgement: how many keys were scheduled for resync."""
+
+    scheduled: int = 0
+
+    def to_obj(self) -> Any:
+        return [self.scheduled]
+
+    @classmethod
+    def from_obj(cls, obj: Any) -> "SyncAckFromServer":
+        return cls(obj[0])
+
+
+# --------------------------------------------------------------------------
 # Envelope
 
 _PAYLOAD_TYPES: Tuple[Type, ...] = (
@@ -396,6 +488,10 @@ _PAYLOAD_TYPES: Tuple[Type, ...] = (
     RequestFailedFromServer,
     HelloToServer,
     HelloFromServer,
+    SyncRequestToServer,
+    SyncEntriesFromServer,
+    NudgeSyncToServer,
+    SyncAckFromServer,
 )
 _TAG_BY_TYPE = {cls: i for i, cls in enumerate(_PAYLOAD_TYPES)}
 

@@ -46,6 +46,9 @@ async def amain(args) -> None:
         # keeps it out of the first client's commit latency) — READY is only
         # printed once the verifier can serve.
         verifier = TpuBatchVerifier(warmup_buckets=(16,))
+    snapshot_path = None
+    if args.data_dir:
+        snapshot_path = str(Path(args.data_dir) / f"{args.server_id}.snapshot")
     replica = MochiReplica(
         server_id=args.server_id,
         config=config,
@@ -53,8 +56,15 @@ async def amain(args) -> None:
         verifier=verifier,
         host=args.host or info.host,
         port=info.port,
+        snapshot_path=snapshot_path,
+        snapshot_interval_s=args.snapshot_interval,
     )
     await replica.start()
+    if args.resync_on_boot:
+        # Replica state is in-memory (like the reference): after a restart,
+        # pull committed state from peers before serving (paper's UptoSpeed).
+        advanced = await replica.resync()
+        logging.info("boot resync: %d objects recovered", advanced)
     admin = None
     if args.admin_port is not None:
         from ..admin import AdminServer
@@ -84,6 +94,22 @@ def main(argv=None) -> None:
         type=int,
         default=None,
         help="serve the HTTP admin shell (/status, /metrics) on this port",
+    )
+    parser.add_argument(
+        "--data-dir",
+        default=None,
+        help="persist state snapshots here (reference has no durability at all)",
+    )
+    parser.add_argument(
+        "--snapshot-interval",
+        type=float,
+        default=30.0,
+        help="seconds between periodic snapshots (with --data-dir)",
+    )
+    parser.add_argument(
+        "--resync-on-boot",
+        action="store_true",
+        help="pull committed state from peers before serving (UptoSpeed)",
     )
     parser.add_argument("--log-level", default="INFO")
     args = parser.parse_args(argv)

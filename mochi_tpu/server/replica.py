@@ -18,23 +18,28 @@ datastore.  Flow per inbound envelope:
 
 from __future__ import annotations
 
+import asyncio
 import logging
 import time
 import uuid
 from dataclasses import replace
-from typing import Dict, Optional
+from typing import Dict, Iterable, Optional
 
 from ..cluster.config import ClusterConfig
 from ..crypto.keys import KeyPair
-from ..net.transport import RpcServer
+from ..net.transport import RpcClientPool, RpcServer
 from ..protocol import (
     Envelope,
     FailType,
     HelloFromServer,
     HelloToServer,
+    NudgeSyncToServer,
     ReadFromServer,
     ReadToServer,
     RequestFailedFromServer,
+    SyncAckFromServer,
+    SyncEntriesFromServer,
+    SyncRequestToServer,
     Write1OkFromServer,
     Write1RefusedFromServer,
     Write1ToServer,
@@ -61,6 +66,8 @@ class MochiReplica:
         require_client_auth: bool = False,
         host: str = "0.0.0.0",
         port: int = 8081,  # ref default port: MochiServer.java:33-34
+        snapshot_path: Optional[str] = None,
+        snapshot_interval_s: float = 0.0,
     ):
         self.server_id = server_id
         self.config = config
@@ -71,13 +78,59 @@ class MochiReplica:
         self.store = DataStore(server_id, config)
         self.rpc = RpcServer(host, port, self.handle_envelope)
         self.metrics = Metrics()
+        # server->server pool (state transfer); lazily connected
+        self.peer_pool = RpcClientPool()
+        self._sync_tasks: set = set()
+        self._pending_sync_keys: set = set()
+        self._sync_worker: Optional[asyncio.Task] = None
+        self.snapshot_path = snapshot_path
+        self.snapshot_interval_s = snapshot_interval_s
+        self._snapshot_task: Optional[asyncio.Task] = None
 
     # ----------------------------------------------------------------- boot
 
     async def start(self) -> None:
+        if self.snapshot_path:
+            from . import persistence
+
+            n = persistence.load_snapshot(self.store, self.snapshot_path)
+            if n:
+                self.metrics.mark("replica.snapshot-loaded", n)
         await self.rpc.start()
+        if self.snapshot_path and self.snapshot_interval_s > 0:
+            self._snapshot_task = asyncio.ensure_future(self._snapshot_loop())
+
+    async def _snapshot_loop(self) -> None:
+        from . import persistence
+
+        while True:
+            await asyncio.sleep(self.snapshot_interval_s)
+            try:
+                # Serialize ON the event loop (the store mutates only there —
+                # snapshotting from a thread would race dict iteration and
+                # could tear a StoreValue mid-_apply); only the fsync'd file
+                # write goes to the executor.
+                blob = persistence.snapshot_bytes(self.store)
+                await asyncio.get_running_loop().run_in_executor(
+                    None, persistence.write_snapshot_blob, blob, self.snapshot_path
+                )
+                self.metrics.mark("replica.snapshots")
+            except Exception:
+                LOG.exception("periodic snapshot failed")
 
     async def close(self) -> None:
+        if self._snapshot_task is not None:
+            self._snapshot_task.cancel()
+        for task in list(self._sync_tasks):
+            task.cancel()
+        if self.snapshot_path:
+            from . import persistence
+
+            try:
+                persistence.write_snapshot(self.store, self.snapshot_path)
+            except Exception:
+                LOG.exception("final snapshot failed")
+        await self.peer_pool.close()
         await self.rpc.close()
 
     @property
@@ -160,10 +213,116 @@ class MochiReplica:
                     )
                 result = self.store.process_write2(replace(payload, write_certificate=checked))
             return self._respond(env, result)
+        if isinstance(payload, SyncRequestToServer):
+            # Serve committed state for transfer.  No trust needed on either
+            # side: entries are (transaction, certificate) pairs the receiver
+            # re-validates via the Write2 checks.
+            entries = self.store.export_sync_entries(
+                payload.keys, min(payload.max_entries, 1024), payload.after_key
+            )
+            return self._respond(env, SyncEntriesFromServer(tuple(entries)))
+        if isinstance(payload, NudgeSyncToServer):
+            # Advisory lag hint (paper's client-initiated UptoSpeed,
+            # mochiDB.tex:168-169): queue the keys for the single background
+            # sync worker.  One worker + coalesced key set = built-in rate
+            # limit (a nudge flood can at worst keep one resync loop busy,
+            # not spawn unbounded concurrent certificate verification).
+            keys = payload.keys[:1024]
+            self.metrics.mark("replica.sync-nudges")
+            self._pending_sync_keys.update(keys)
+            self._kick_sync_worker()
+            return self._respond(env, SyncAckFromServer(len(keys)))
         LOG.warning("unhandled payload type %s", type(payload).__name__)
         return self._respond(
             env, RequestFailedFromServer(FailType.OLD_REQUEST, "unhandled payload")
         )
+
+    # ---------------------------------------------------------------- resync
+
+    def _kick_sync_worker(self) -> None:
+        if self._sync_worker is None or self._sync_worker.done():
+            self._sync_worker = asyncio.ensure_future(self._sync_worker_loop())
+            self._sync_tasks.add(self._sync_worker)
+            self._sync_worker.add_done_callback(self._sync_tasks.discard)
+
+    async def _sync_worker_loop(self) -> None:
+        """Drain nudged keys in batches until the pending set is empty."""
+        while self._pending_sync_keys:
+            batch = set(list(self._pending_sync_keys)[:1024])
+            self._pending_sync_keys -= batch
+            try:
+                await self.resync(batch)
+            except Exception:
+                LOG.exception("background resync failed")
+
+    def _signed_request(self, payload) -> Envelope:
+        env = Envelope(
+            payload=payload,
+            msg_id=uuid.uuid4().hex,
+            sender_id=self.server_id,
+            timestamp_ms=int(time.time() * 1000),
+        )
+        return env.with_signature(self.keypair.sign(env.signing_bytes()))
+
+    async def resync(
+        self, keys: Optional[Iterable[str]] = None, timeout_s: float = 5.0
+    ) -> int:
+        """Pull committed state from peers and apply whatever is newer.
+
+        The paper's UptoSpeed recovery (``mochiDB.tex:168-169``), which the
+        reference never built (SURVEY.md §5): after a restart (state is
+        in-memory, like the reference) this replica's epochs restart at 0 and
+        its Write1 grants can never again agree with the surviving quorum —
+        resync re-hydrates (value, certificate, epoch) per key.  Every entry
+        is validated exactly like a client Write2 (2f+1 signed in-set grants,
+        transaction-hash match, staleness), so a Byzantine peer can at worst
+        send us stale-but-valid state, which the timestamp check ignores.
+
+        Returns the number of objects whose state advanced.
+        """
+        key_tuple = tuple(keys) if keys is not None else None
+        page = 1024
+        peers = [
+            info
+            for sid, info in self.config.servers.items()
+            if sid != self.server_id
+        ]
+        advanced_keys: set = set()
+
+        async def pull_peer(info) -> None:
+            after: Optional[str] = None
+            while True:  # page until a short page (or error/foreign payload)
+                request = SyncRequestToServer(
+                    keys=key_tuple, max_entries=page, after_key=after
+                )
+                try:
+                    res = await self.peer_pool.send_and_receive(
+                        info, self._signed_request(request), timeout_s
+                    )
+                except Exception:
+                    return
+                if not isinstance(res.payload, SyncEntriesFromServer):
+                    return
+                entries = res.payload.entries
+                for entry in entries:
+                    if not self.store.owns(entry.key):
+                        continue
+                    checked = await self._check_certificate(entry.certificate)
+                    if checked is None:
+                        self.metrics.mark("replica.resync-bad-certificate")
+                        continue
+                    if self.store.apply_sync_entry(replace(entry, certificate=checked)):
+                        advanced_keys.add(entry.key)
+                if len(entries) < page:
+                    return
+                after = entries[-1].key
+
+        with self.metrics.timer("replica.resync"):
+            await asyncio.gather(*(pull_peer(info) for info in peers))
+        if advanced_keys:
+            LOG.info("resync advanced %d objects", len(advanced_keys))
+            self.metrics.mark("replica.resync-applied", len(advanced_keys))
+        return len(advanced_keys)
 
     async def _check_certificate(self, wc: WriteCertificate) -> Optional[WriteCertificate]:
         """Verify every MultiGrant signature in a write certificate; drop

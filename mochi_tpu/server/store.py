@@ -32,7 +32,7 @@ from __future__ import annotations
 
 import logging
 from dataclasses import dataclass, field as dc_field
-from typing import Dict, List, Optional, Tuple, Union
+from typing import Dict, Iterable, List, Optional, Tuple, Union
 
 from ..cluster.config import CONFIG_KEY_PREFIX, ClusterConfig
 from ..protocol import (
@@ -44,6 +44,7 @@ from ..protocol import (
     OperationResult,
     RequestFailedFromServer,
     Status,
+    SyncEntry,
     Transaction,
     TransactionResult,
     Write1OkFromServer,
@@ -77,6 +78,12 @@ class StoreValue:
     # epoch -> timestamp -> Grant (ref: givenWrite1Grants, SVOC.java:38-40)
     grants: Dict[int, Dict[int, Grant]] = dc_field(default_factory=dict)
     current_epoch: int = 0
+    # The transaction the current certificate committed — kept so this
+    # replica can serve trustless state transfer (SyncEntry carries
+    # (transaction, certificate); receivers re-validate via the Write2
+    # checks).  The reference stores only the certificate (SVOC.java:24-53)
+    # and therefore cannot implement the paper's UptoSpeed resync.
+    last_transaction: Optional["Transaction"] = None
 
     @staticmethod
     def epoch_of(ts: int) -> int:
@@ -108,20 +115,27 @@ class StoreValue:
             del self.grants[epoch]
 
     def certificate_timestamp(self) -> Optional[int]:
-        """Timestamp agreed by the current certificate's grants for this key
-        (ref: ``getCurrentTimestampFromCurrentCertificate``, SVOC.java:175-198)."""
+        """Timestamp certified for this key by the current certificate
+        (ref: ``getCurrentTimestampFromCurrentCertificate``, SVOC.java:175-198).
+
+        Counts only OK-status grants and takes the majority timestamp: the
+        quorum check at apply time guarantees >= 2f+1 OK grants agreed on the
+        winning timestamp, but a stored certificate may ALSO carry validly
+        signed non-OK (refused/wrong-shard) or minority grants from Byzantine
+        in-set peers — those must not be able to poison this accessor (a
+        raise here would brick the key for every later Write2/resync).
+        """
         if self.current_certificate is None:
             return None
-        ts: Optional[int] = None
+        counts: Dict[int, int] = {}
         for mg in self.current_certificate.grants.values():
             grant = mg.grants.get(self.key)
-            if grant is None:
+            if grant is None or grant.status != Status.OK:
                 continue
-            if ts is None:
-                ts = grant.timestamp
-            elif ts != grant.timestamp:
-                raise ValueError(f"certificate timestamps disagree for {self.key}")
-        return ts
+            counts[grant.timestamp] = counts.get(grant.timestamp, 0) + 1
+        if not counts:
+            return None
+        return max(counts.items(), key=lambda kv: kv[1])[0]
 
 
 Write1Response = Union[Write1OkFromServer, Write1RefusedFromServer]
@@ -326,13 +340,18 @@ class DataStore:
                 # (ref: InMemoryDataStore.java:594-598).
                 result = OperationResult(sv.value, sv.current_certificate, sv.exists, Status.OK)
             else:
-                result = self._apply(op, sv, ts, req.write_certificate)
+                result = self._apply(op, sv, ts, req.write_certificate, transaction)
             applied[op.key] = result
             results.append(result)
         return Write2AnsFromServer(TransactionResult(tuple(results)), rid="")
 
     def _apply(
-        self, op: Operation, sv: StoreValue, ts: int, wc: WriteCertificate
+        self,
+        op: Operation,
+        sv: StoreValue,
+        ts: int,
+        wc: WriteCertificate,
+        transaction: Transaction,
     ) -> OperationResult:
         """Commit one operation (ref: ``applyOperation``,
         ``InMemoryDataStore.java:521-554``)."""
@@ -341,6 +360,7 @@ class DataStore:
             return OperationResult(sv.value, sv.current_certificate, sv.exists, Status.OK)
         existed_before = sv.exists
         sv.current_certificate = wc
+        sv.last_transaction = transaction
         sv.delete_grant(ts)
         sv.advance_epoch(ts)
         if op.action == Action.WRITE:
@@ -350,6 +370,56 @@ class DataStore:
             sv.value = None
             sv.exists = False
         return OperationResult(op.value, wc, existed_before, Status.OK)
+
+    # ----------------------------------------------------------------- sync
+
+    def export_sync_entries(
+        self,
+        keys: Optional[Iterable[str]] = None,
+        max_entries: int = 1024,
+        after_key: Optional[str] = None,
+    ) -> List[SyncEntry]:
+        """Committed (transaction, certificate) pairs for state transfer.
+
+        Serves the paper's UptoSpeed (``mochiDB.tex:168-169``).  Only owned
+        keys with a commit history are exported; each entry is independently
+        verifiable by the receiver.  Keys are walked in sorted order so
+        callers can page with ``after_key`` (resync loops until a short
+        page); both keyspaces (data + ``_CONFIG_``) are covered.
+        """
+        if keys is None:
+            candidates: Iterable[str] = sorted(
+                list(self.data.keys()) + list(self.data_config.keys())
+            )
+        else:
+            candidates = sorted(keys)
+        out: List[SyncEntry] = []
+        for key in candidates:
+            if after_key is not None and key <= after_key:
+                continue
+            if len(out) >= max_entries:
+                break
+            if not self.owns(key):
+                continue
+            sv = self._get(key)
+            if sv is None or sv.current_certificate is None or sv.last_transaction is None:
+                continue
+            out.append(SyncEntry(key, sv.last_transaction, sv.current_certificate))
+        return out
+
+    def apply_sync_entry(self, entry: SyncEntry) -> bool:
+        """Apply one state-transfer entry through the full Write2 validation
+        (quorum, hash, staleness).  Returns True if state advanced."""
+        sv_before = self._get(entry.key)
+        ts_before = sv_before.certificate_timestamp() if sv_before else None
+        response = self.process_write2(
+            Write2ToServer(entry.certificate, entry.transaction)
+        )
+        if not isinstance(response, Write2AnsFromServer):
+            return False
+        sv_after = self._get(entry.key)
+        ts_after = sv_after.certificate_timestamp() if sv_after else None
+        return ts_after is not None and ts_after != ts_before
 
 
 class BadCertificate(Exception):

@@ -105,6 +105,31 @@ class VirtualCluster:
     def replica(self, server_id: str) -> MochiReplica:
         return next(r for r in self.replicas if r.server_id == server_id)
 
+    async def restart_replica(self, server_id: str, resync: bool = False) -> MochiReplica:
+        """Kill a replica and boot a fresh one on the same port with EMPTY
+        state (storage is in-memory, as in the reference) — the crash-recovery
+        scenario the resync protocol exists for."""
+        old = self.replica(server_id)
+        port = old.bound_port
+        if old.verifier is not None:
+            await old.verifier.close()
+        await old.close()
+        fresh = MochiReplica(
+            server_id=server_id,
+            config=self.config,
+            keypair=self.keypairs[server_id],
+            verifier=self.verifier_factory() if self.verifier_factory else None,
+            client_public_keys=self.client_keys,
+            require_client_auth=self.require_client_auth,
+            host=self.host,
+            port=port,
+        )
+        await fresh.start()
+        self.replicas[self.replicas.index(old)] = fresh
+        if resync:
+            await fresh.resync()
+        return fresh
+
     async def close(self) -> None:
         for client in self._clients:
             await client.close()

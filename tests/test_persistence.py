@@ -1,0 +1,95 @@
+"""Snapshot persistence: round-trip, atomicity, and restart recovery.
+
+The reference has zero durability (in-memory maps only, SURVEY.md §5); these
+tests cover the new snapshot+reload path and its interplay with resync.
+"""
+
+import asyncio
+import os
+
+import pytest
+
+from mochi_tpu.client import TransactionBuilder
+from mochi_tpu.server import persistence
+from mochi_tpu.server.replica import MochiReplica
+from mochi_tpu.server.store import DataStore
+from mochi_tpu.testing import VirtualCluster
+
+
+def run(coro):
+    asyncio.run(asyncio.wait_for(coro, timeout=120))
+
+
+def test_snapshot_roundtrip(tmp_path):
+    async def main():
+        async with VirtualCluster(4, rf=4) as vc:
+            client = vc.client()
+            await client.execute_write_transaction(
+                TransactionBuilder().write("pk1", b"v1").write("pk2", b"v2").build()
+            )
+            await client.execute_write_transaction(
+                TransactionBuilder().delete("pk2").build()
+            )
+            replica = vc.replicas[0]
+            path = str(tmp_path / "snap")
+            n_bytes = persistence.write_snapshot(replica.store, path)
+            assert n_bytes > 0 and os.path.exists(path)
+
+            fresh = DataStore(replica.server_id, vc.config)
+            n = persistence.load_snapshot(fresh, path)
+            assert n is not None and n >= 2
+            assert fresh.data["pk1"].value == b"v1"
+            assert fresh.data["pk1"].exists
+            assert not fresh.data["pk2"].exists
+            # certificates and epochs survive (what resync/write1 need)
+            assert fresh.data["pk1"].current_certificate is not None
+            assert fresh.data["pk1"].current_epoch == replica.store.data["pk1"].current_epoch
+            assert fresh.data["pk1"].last_transaction is not None
+
+    run(main())
+
+
+def test_snapshot_reload_enables_writes_without_resync(tmp_path):
+    """After restart-with-snapshot, epochs match the quorum again, so warm-key
+    writes converge with no state transfer at all."""
+
+    async def main():
+        async with VirtualCluster(4, rf=4) as vc:
+            client = vc.client()
+            await client.execute_write_transaction(
+                TransactionBuilder().write("durable", b"v1").build()
+            )
+            victim = vc.replica("server-0")
+            path = str(tmp_path / "s0.snapshot")
+            persistence.write_snapshot(victim.store, path)
+
+            fresh = await vc.restart_replica("server-0")
+            assert persistence.load_snapshot(fresh.store, path) >= 1
+
+            await client.execute_write_transaction(
+                TransactionBuilder().write("durable", b"v2").build()
+            )
+            res = await client.execute_read_transaction(
+                TransactionBuilder().read("durable").build()
+            )
+            assert res.operations[0].value == b"v2"
+
+    run(main())
+
+
+def test_corrupt_snapshot_rejected(tmp_path):
+    store = DataStore("server-x", _tiny_config())
+    path = str(tmp_path / "bad")
+    with open(path, "wb") as fh:
+        fh.write(b"\x08\x01\x06\x05magic\x06\x03bad")
+    with pytest.raises(ValueError):
+        persistence.load_snapshot(store, path)
+    assert persistence.load_snapshot(store, str(tmp_path / "missing")) is None
+
+
+def _tiny_config():
+    from mochi_tpu.cluster.config import ClusterConfig
+
+    return ClusterConfig.build(
+        {f"server-{i}": f"127.0.0.1:{9000+i}" for i in range(4)}, rf=4
+    )

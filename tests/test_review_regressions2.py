@@ -1,0 +1,95 @@
+"""Regressions for the resync/persistence review findings.
+
+1. A stored certificate carrying extra validly-signed non-OK or minority
+   grants must not poison ``certificate_timestamp`` (would brick the key for
+   every later Write2 and crash resync).
+2. State transfer pages through stores larger than one page.
+3. Snapshots from another server id are refused.
+"""
+
+import asyncio
+
+from mochi_tpu.client import TransactionBuilder
+from mochi_tpu.protocol import Grant, MultiGrant, Status, WriteCertificate
+from mochi_tpu.server.store import StoreValue
+from mochi_tpu.testing import VirtualCluster
+
+
+def run(coro):
+    asyncio.run(asyncio.wait_for(coro, timeout=120))
+
+
+def test_certificate_timestamp_survives_byzantine_extras():
+    ok = lambda sid, ts: MultiGrant(
+        {"k": Grant("k", ts, 1, b"h", Status.OK)}, "c", sid
+    )
+    refused = MultiGrant({"k": Grant("k", 777, 1, b"h", Status.REFUSED)}, "c", "s4")
+    minority = MultiGrant({"k": Grant("k", 999, 1, b"h", Status.OK)}, "c", "s5")
+    sv = StoreValue("k")
+    sv.current_certificate = WriteCertificate(
+        {"s1": ok("s1", 500), "s2": ok("s2", 500), "s3": ok("s3", 500),
+         "s4": refused, "s5": minority}
+    )
+    # majority OK timestamp wins; no exception despite disagreeing grants
+    assert sv.certificate_timestamp() == 500
+
+    # all-non-OK degenerates to None, not a crash
+    sv2 = StoreValue("k")
+    sv2.current_certificate = WriteCertificate({"s4": refused})
+    assert sv2.certificate_timestamp() is None
+
+
+def test_resync_pages_through_large_store():
+    async def main():
+        async with VirtualCluster(4, rf=4) as vc:
+            client = vc.client()
+            # more keys than one sync page (use a small page to keep it fast)
+            for batch_start in range(0, 30, 10):
+                tb = TransactionBuilder()
+                for i in range(batch_start, batch_start + 10):
+                    tb.write(f"pg-{i:03d}", b"v%d" % i)
+                await client.execute_write_transaction(tb.build())
+
+            donor = vc.replica("server-1")
+            page = donor.store.export_sync_entries(max_entries=7)
+            assert len(page) == 7
+            collected = {e.key for e in page}
+            while True:
+                nxt = donor.store.export_sync_entries(
+                    max_entries=7, after_key=page[-1].key
+                )
+                if not nxt:
+                    break
+                collected |= {e.key for e in nxt}
+                if len(nxt) < 7:
+                    break
+                page = nxt
+            assert {f"pg-{i:03d}" for i in range(30)} <= collected
+
+            # end-to-end: restarted replica recovers everything via resync
+            fresh = await vc.restart_replica("server-0", resync=True)
+            have = {
+                k for k, sv in fresh.store.data.items() if sv.current_certificate
+            }
+            assert {f"pg-{i:03d}" for i in range(30)} <= have
+
+    run(main())
+
+
+def test_snapshot_wrong_server_refused(tmp_path):
+    from mochi_tpu.cluster.config import ClusterConfig
+    from mochi_tpu.server import persistence
+    from mochi_tpu.server.store import DataStore
+
+    cfg = ClusterConfig.build(
+        {f"server-{i}": f"127.0.0.1:{9100+i}" for i in range(4)}, rf=4
+    )
+    donor = DataStore("server-1", cfg)
+    path = str(tmp_path / "s1.snapshot")
+    persistence.write_snapshot(donor, path)
+    other = DataStore("server-0", cfg)
+    try:
+        persistence.load_snapshot(other, path)
+        raise AssertionError("expected ValueError")
+    except ValueError as exc:
+        assert "server-1" in str(exc)
