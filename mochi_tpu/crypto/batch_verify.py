@@ -22,6 +22,7 @@ malformed inputs.
 from __future__ import annotations
 
 import hashlib
+import os
 import threading
 from typing import List, Optional, Sequence
 
@@ -35,6 +36,13 @@ from . import field as F
 from ..verifier.spi import VerifyItem
 
 MIN_BUCKET = 16
+
+
+def _impl() -> str:
+    """Device implementation: "xla" (default) or "pallas"
+    (``MOCHI_VERIFY_IMPL=pallas`` — the hand-tiled kernel,
+    :mod:`mochi_tpu.crypto.pallas_verify`)."""
+    return os.environ.get("MOCHI_VERIFY_IMPL", "xla")
 
 
 def _bucket_size(n: int) -> int:
@@ -121,7 +129,12 @@ def verify_batch(
     args = (y_a, sign_a, y_r, sign_r, s_bits, h_bits)
     if device is not None:
         args = tuple(jax.device_put(a, device) for a in args)
-    bitmap = np.asarray(_verify_jit(*args))[:n]
+    if _impl() == "pallas":
+        from . import pallas_verify
+
+        bitmap = np.asarray(pallas_verify.verify_prepared_pallas(*args))[:n]
+    else:
+        bitmap = np.asarray(_verify_jit(*args))[:n]
     return [bool(b) for b in np.logical_and(bitmap, pre_ok)]
 
 
