@@ -22,6 +22,7 @@ malformed inputs.
 from __future__ import annotations
 
 import hashlib
+import logging
 import os
 import threading
 from typing import List, Optional, Sequence
@@ -34,6 +35,8 @@ import jax.numpy as jnp
 from . import curve
 from . import field as F
 from ..verifier.spi import VerifyItem
+
+LOG = logging.getLogger(__name__)
 
 MIN_BUCKET = 16
 

@@ -7,12 +7,21 @@ non-square), so identity/doubling/degenerate cases need no case analysis —
 exactly the property that makes Ed25519 verification map cleanly onto a
 vector machine (SURVEY.md §7: "no data-dependent Python control flow").
 
-A point is a 4-tuple (X, Y, Z, T) of field elements (int32 ``(..., 16)``
-limb arrays, :mod:`mochi_tpu.crypto.field`), with x = X/Z, y = Y/Z,
-T = XY/Z.  Scalars arrive as little-endian bit arrays ``(..., 256)``
-precomputed on the host (the host also does SHA-512 and the mod-L
-reduction: variable-length hashing is host work; the device sees only
-fixed-shape integer tensors).
+Layout (round-2 rework): **limbs-leading**.  A point is a 4-tuple
+(X, Y, Z, T) of field elements shaped ``(17, ...lanes)``
+(:mod:`mochi_tpu.crypto.field`), with x = X/Z, y = Y/Z, T = XY/Z — batch on
+the trailing lane axis, which is the TPU's 128-wide vector axis, so every
+field op runs on dense lane vectors.  This is the layout the round-1 Pallas
+kernel introduced; now it *is* the XLA path, and the Pallas kernel
+(:mod:`mochi_tpu.crypto.pallas_verify`) wraps the same :func:`verify_core`.
+
+Scalars arrive as little-endian bit arrays precomputed on the host (the host
+also does SHA-512 and the mod-L reduction: variable-length hashing is host
+work; the device sees only fixed-shape integer tensors).
+
+Table lookups in the windowed ladder are branchless masked-select sums
+(:func:`select_entry`): data-dependent per-lane gathers don't vectorize on
+the TPU VPU; 16 masked adds do.
 
 The reference never implements any of this (it never signs — SURVEY.md
 preamble); this is the north-star TPU verifier path of BASELINE.json.
@@ -31,7 +40,8 @@ from . import field as F
 
 
 class Point(NamedTuple):
-    """Extended coordinates (X : Y : Z : T), x=X/Z, y=Y/Z, T=XY/Z."""
+    """Extended coordinates (X : Y : Z : T), x=X/Z, y=Y/Z, T=XY/Z.
+    Each coordinate is a (17, ...lanes) limb array."""
 
     x: jnp.ndarray
     y: jnp.ndarray
@@ -39,18 +49,17 @@ class Point(NamedTuple):
     t: jnp.ndarray
 
 
-def identity(batch_shape) -> Point:
-    zero = F.zeros_like_batch(batch_shape)
-    one = zero.at[..., 0].set(1)
+def identity(lanes) -> Point:
+    zero = F.zeros(lanes)
+    one = F.one(lanes)
     return Point(zero, one, one, zero)
 
 
-def basepoint(batch_shape) -> Point:
-    """The Ed25519 basepoint B, broadcast over a batch."""
-    bx = jnp.broadcast_to(F.const(F.BX_INT), (*batch_shape, F.NLIMBS))
-    by = jnp.broadcast_to(F.const(F.BY_INT), (*batch_shape, F.NLIMBS))
-    one = F.zeros_like_batch(batch_shape).at[..., 0].set(1)
-    return Point(bx, by, one, F.mul(bx, by))
+def basepoint(lanes) -> Point:
+    """The Ed25519 basepoint B, broadcast over the lane shape."""
+    bx = F.const(F.BX_INT, lanes)
+    by = F.const(F.BY_INT, lanes)
+    return Point(bx, by, F.one(lanes), F.mul(bx, by))
 
 
 # 2*d mod p, a trace-time constant
@@ -61,7 +70,7 @@ def add(p: Point, q: Point) -> Point:
     """Complete unified addition (add-2008-hwcd-3, a=-1). ~9 field muls."""
     a = F.mul(F.sub(p.y, p.x), F.sub(q.y, q.x))
     b = F.mul(F.add(p.y, p.x), F.add(q.y, q.x))
-    c = F.mul(F.mul(p.t, F.const(_D2_INT)), q.t)
+    c = F.mul(F.mul(p.t, F.const(_D2_INT, p.t.shape[1:])), q.t)
     d = F.mul(F.add(p.z, p.z), q.z)
     e = F.sub(b, a)
     f = F.sub(d, c)
@@ -93,15 +102,16 @@ def select_point(cond: jnp.ndarray, p: Point, q: Point) -> Point:
 def decompress(y_limbs: jnp.ndarray, sign: jnp.ndarray) -> Tuple[Point, jnp.ndarray]:
     """RFC 8032 §5.1.3 point decoding, batched and branchless.
 
-    ``y_limbs``: (..., 16) with y < p (host prechecks canonicity);
-    ``sign``: (...,) int32 in {0,1} — the x-parity bit from byte 31.
+    ``y_limbs``: (17, lanes) with y < p (host prechecks canonicity);
+    ``sign``: (lanes,) int32 in {0,1} — the x-parity bit from byte 31.
     Returns (point with Z=1, ok) where ok=False marks non-points
     (x^2 = u/v has no root, or x=0 with sign=1).
     """
+    lanes = y_limbs.shape[1:]
     yy = F.square(y_limbs)
-    one = F.zeros_like_batch(y_limbs.shape[:-1]).at[..., 0].set(1)
+    one = F.one(lanes)
     u = F.sub(yy, one)  # y^2 - 1
-    v = F.add(F.mul(yy, F.const(F.D_INT)), one)  # d*y^2 + 1
+    v = F.add(F.mul(yy, F.const(F.D_INT, lanes)), one)  # d*y^2 + 1
 
     # candidate root x = u * v^3 * (u*v^7)^((p-5)/8)
     v3 = F.mul(F.square(v), v)
@@ -111,65 +121,24 @@ def decompress(y_limbs: jnp.ndarray, sign: jnp.ndarray) -> Tuple[Point, jnp.ndar
     vxx = F.mul(v, F.square(x))
     root_ok = F.eq(vxx, u)
     root_neg = F.eq(vxx, F.neg(u))
-    x = F.select(root_neg, F.mul(x, F.const(F.SQRT_M1_INT)), x)
+    x = F.select(root_neg, F.mul(x, F.const(F.SQRT_M1_INT, lanes)), x)
     ok = root_ok | root_neg
 
     x_can = F.canonical(x)
-    x_is_zero = F.is_zero(x)
+    x_is_zero = jnp.all(x_can == 0, axis=0)
     ok = ok & ~(x_is_zero & (sign == 1))
     # flip sign to match the encoded parity bit
-    flip = (x_can[..., 0] & 1) != sign
+    flip = (x_can[0] & 1) != sign
     x = F.select(flip, F.neg(x), x)
 
     return Point(x, y_limbs, one, F.mul(x, y_limbs)), ok
 
 
-def double_scalar_mul(
-    s_bits: jnp.ndarray, p_bits: jnp.ndarray, p_point: Point
-) -> Point:
-    """[s]B + [p]P by joint 1-bit Straus: 256 x (double + complete add).
-
-    ``s_bits``/``p_bits``: (..., 256) little-endian bits.  The 4-entry
-    table {O, B, P, B+P} is gathered per item per iteration — data-dependent
-    *gathers* are fine under jit; only control flow must be static.
-    """
-    batch_shape = s_bits.shape[:-1]
-    bp = basepoint(batch_shape)
-    tab_o = identity(batch_shape)
-    tab_bp = add(bp, p_point)
-    # per coordinate: (..., 4, limbs) — table entries stacked on a new axis
-    table = [
-        jnp.stack([o, b, p, s], axis=-2)
-        for o, b, p, s in zip(tab_o, bp, p_point, tab_bp)
-    ]
-
-    def body(i, q):
-        bit_idx = 255 - i
-        sb = s_bits[..., bit_idx]
-        pb = p_bits[..., bit_idx]
-        q = double(q)
-        idx = (sb + 2 * pb).astype(jnp.int32)
-        entry = Point(
-            *(
-                jnp.take_along_axis(t, idx[..., None, None], axis=-2).squeeze(-2)
-                for t in table
-            )
-        )
-        return add(q, entry)
-
-    q0 = identity(batch_shape)
-    q = lax.fori_loop(0, 256, body, q0)
-    return q
-
-
 # --------------------------------------------------------------------------
-# Windowed double-scalar-mul: 4-bit digits.  vs the 1-bit Straus ladder:
-# same 256 doublings but 64+64 windowed additions instead of 256 complete
-# additions, and the base-point additions use a precomputed constant table
-# in Niels form (y+x, y-x, 2dxy) which saves 2 muls per addition.
-# ~3200 field muls/signature vs ~4900 for the 1-bit ladder.
-
-_NIELS_IDENTITY = (1, 1, 0)  # (y+x, y-x, 2dxy) of the neutral element
+# Windowed double-scalar-mul: 4-bit digits, msb-first over 64 windows.
+# Per window: 4 doublings, one complete addition from the per-item [0..15]P
+# table, one Niels mixed addition from the constant [0..15]B table (saves
+# 2 muls per addition).  ~3200 field muls/signature.
 
 
 def _py_edwards_add(p, q):
@@ -220,59 +189,106 @@ def madd_niels(
 
 
 def digits4_from_bits(bits: jnp.ndarray) -> jnp.ndarray:
-    """(..., 256) little-endian bits -> (..., 64) base-16 digits."""
-    w = jnp.asarray([1, 2, 4, 8], dtype=jnp.int32)
-    return jnp.einsum(
-        "...wb,b->...w", bits.reshape(*bits.shape[:-1], 64, 4), w
-    ).astype(jnp.int32)
+    """(256, lanes) little-endian bits -> (64, lanes) base-16 digits."""
+    lanes = bits.shape[1:]
+    w = jnp.asarray([1, 2, 4, 8], dtype=jnp.int32).reshape(1, 4, *([1] * len(lanes)))
+    return (bits.reshape(64, 4, *lanes) * w).sum(axis=1).astype(jnp.int32)
 
 
-def _small_multiples_table(p: Point) -> list:
-    """[0..15]P in extended coords, per coordinate stacked on axis -2."""
-    pts = [identity(p.x.shape[:-1]), p]
-    for k in range(2, 16):
-        pts.append(double(pts[k // 2]) if k % 2 == 0 else add(pts[k - 1], p))
-    return [jnp.stack(coord, axis=-2) for coord in zip(*pts)]
+def select_entry(table, idx: jnp.ndarray, n_entries: int):
+    """Branchless per-lane table lookup: sum of masked entries.
+
+    ``table``: sequence of arrays with entry axis 0 — each
+    ``(n_entries, 17, lanes)`` (or broadcastable); ``idx``: (lanes,) int32.
+    Data-dependent per-lane gathers don't vectorize on the VPU; n_entries
+    masked adds do.
+    """
+    out = []
+    for coord in table:
+        acc = jnp.zeros_like(coord[0] + jnp.zeros_like(idx))
+        for e in range(n_entries):
+            acc = acc + jnp.where((idx == e)[None], coord[e], 0)
+        out.append(acc)
+    return tuple(out)
+
+
+def _small_multiples_table(p: Point):
+    """[0..15]P stacked on axis 0 — built by 15 chained additions inside ONE
+    fori_loop body (vs 14 unrolled point ops: ~10x smaller traced graph)."""
+    lanes = p.x.shape[1:]
+    ident = identity(lanes)
+    table = tuple(
+        jnp.zeros((16, F.NLIMBS, *lanes), jnp.int32).at[0].set(c) for c in ident
+    )
+
+    def chain(k, carry):
+        table, prev = carry
+        cur = add(Point(*prev), p)
+        table = tuple(
+            lax.dynamic_update_index_in_dim(t, c, k, axis=0)
+            for t, c in zip(table, cur)
+        )
+        return (table, tuple(cur))
+
+    table, _ = lax.fori_loop(1, 16, chain, (table, tuple(ident)))
+    return table
 
 
 def double_scalar_mul_windowed(
-    s_bits: jnp.ndarray, p_bits: jnp.ndarray, p_point: Point
+    s_dig: jnp.ndarray, p_dig: jnp.ndarray, p_point: Point
 ) -> Point:
     """[s]B + [p]P with 4-bit windows, msb-first over 64 windows.
 
-    Per window: 4 doublings, one complete addition from the per-item
-    [0..15]P table (data-dependent gather), one Niels mixed addition from
-    the constant [0..15]B table (shared gather).
+    ``s_dig``/``p_dig``: (64, lanes) base-16 digits (little-endian windows).
     """
-    batch_shape = s_bits.shape[:-1]
-    s_dig = digits4_from_bits(s_bits)
-    p_dig = digits4_from_bits(p_bits)
+    lanes = s_dig.shape[1:]
     a_tab = _small_multiples_table(p_point)
-    b_ypx = jnp.asarray(_B_TAB_YPX)
-    b_ymx = jnp.asarray(_B_TAB_YMX)
-    b_xy2d = jnp.asarray(_B_TAB_XY2D)
+    b_tab = (
+        jnp.asarray(_B_TAB_YPX)[..., None] if lanes else jnp.asarray(_B_TAB_YPX),
+        jnp.asarray(_B_TAB_YMX)[..., None] if lanes else jnp.asarray(_B_TAB_YMX),
+        jnp.asarray(_B_TAB_XY2D)[..., None] if lanes else jnp.asarray(_B_TAB_XY2D),
+    )
 
     def body(i, q):
         w = 63 - i
-        q = double(double(double(double(q))))
-        pd = p_dig[..., w]
-        entry = Point(
-            *(
-                jnp.take_along_axis(t, pd[..., None, None], axis=-2).squeeze(-2)
-                for t in a_tab
-            )
-        )
+        q = double(double(double(double(Point(*q)))))
+        pd = lax.dynamic_index_in_dim(p_dig, w, axis=0, keepdims=False)
+        entry = Point(*select_entry(a_tab, pd, 16))
         q = add(q, entry)
-        sd = s_dig[..., w]
-        q = madd_niels(
-            q,
-            jnp.take(b_ypx, sd, axis=0),
-            jnp.take(b_ymx, sd, axis=0),
-            jnp.take(b_xy2d, sd, axis=0),
-        )
-        return q
+        sd = lax.dynamic_index_in_dim(s_dig, w, axis=0, keepdims=False)
+        nypx, nymx, nxy2d = select_entry(b_tab, sd, 16)
+        return tuple(madd_niels(q, nypx, nymx, nxy2d))
 
-    return lax.fori_loop(0, 64, body, identity(batch_shape))
+    q = lax.fori_loop(0, 64, body, tuple(identity(lanes)))
+    return Point(*q)
+
+
+def verify_core(
+    y_a: jnp.ndarray,
+    sign_a: jnp.ndarray,
+    y_r: jnp.ndarray,
+    sign_r: jnp.ndarray,
+    s_dig: jnp.ndarray,
+    h_dig: jnp.ndarray,
+) -> jnp.ndarray:
+    """Limbs-leading batched verify -> validity bitmap (lanes,) bool.
+
+    Inputs: ``y_a``/``y_r`` (17, lanes) limb tensors; ``sign_*`` (lanes,);
+    ``s_dig``/``h_dig`` (64, lanes) base-16 scalar digits.
+
+    Checks the cofactorless equation [S]B == R + [h]A (as OpenSSL/the CPU
+    path does), rearranged to Q := [S]B + [h](-A), Q == R, compared
+    projectively (X_Q == x_R * Z_Q, Y_Q == y_R * Z_Q) to avoid an inversion.
+    This function is the shared core of the XLA path
+    (:func:`verify_prepared`) and the Pallas kernel
+    (:mod:`mochi_tpu.crypto.pallas_verify`).
+    """
+    a_point, ok_a = decompress(y_a, sign_a)
+    r_point, ok_r = decompress(y_r, sign_r)
+    q = double_scalar_mul_windowed(s_dig, h_dig, negate(a_point))
+    eq_x = F.eq(q.x, F.mul(r_point.x, q.z))
+    eq_y = F.eq(q.y, F.mul(r_point.y, q.z))
+    return ok_a & ok_r & eq_x & eq_y
 
 
 def verify_prepared(
@@ -283,17 +299,15 @@ def verify_prepared(
     s_bits: jnp.ndarray,
     h_bits: jnp.ndarray,
 ) -> jnp.ndarray:
-    """Core batched verify on host-prepared tensors -> validity bitmap.
+    """Batched verify on host-prepared batch-leading tensors -> (B,) bitmap.
 
-    Checks the cofactorless equation [S]B == R + [h]A (as OpenSSL/the CPU
-    path does), rearranged to Q := [S]B + [h](-A), Q == R, compared
-    projectively (X_Q == x_R * Z_Q, Y_Q == y_R * Z_Q) to avoid an inversion.
-    SHA-512, mod-L reduction, and canonical-encoding prechecks (y < p, S < L)
-    happen on the host (:mod:`mochi_tpu.crypto.batch_verify`).
+    External API (unchanged from round 1 modulo limb count): ``y_a``/``y_r``
+    (B, 17), ``sign_*`` (B,), ``s_bits``/``h_bits`` (B, 256) little-endian
+    bits.  SHA-512, mod-L reduction, and canonical-encoding prechecks
+    (y < p, S < L) happen on the host
+    (:mod:`mochi_tpu.crypto.batch_verify`).  Internally transposes to the
+    limbs-leading layout (one fused transpose each way in XLA).
     """
-    a_point, ok_a = decompress(y_a, sign_a)
-    r_point, ok_r = decompress(y_r, sign_r)
-    q = double_scalar_mul_windowed(s_bits, h_bits, negate(a_point))
-    eq_x = F.eq(q.x, F.mul(r_point.x, q.z))
-    eq_y = F.eq(q.y, F.mul(r_point.y, q.z))
-    return ok_a & ok_r & eq_x & eq_y
+    s_dig = digits4_from_bits(s_bits.T)
+    h_dig = digits4_from_bits(h_bits.T)
+    return verify_core(y_a.T, sign_a, y_r.T, sign_r, s_dig, h_dig)

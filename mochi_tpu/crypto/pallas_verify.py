@@ -1,20 +1,23 @@
 """Pallas TPU kernel for batched Ed25519 verification.
 
-The XLA path (:mod:`curve`/:mod:`field`) lays field elements out as
-``(batch, 16)`` — limbs on the 128-wide lane axis, wasting 7/8 of each VPU
-lane group and letting XLA decide fusion boundaries (HBM round-trips between
-them).  This kernel owns the layout instead (pallas_guide.md: tiling is
-(sublane, lane) with lane=128):
+Round-2 note: the XLA path (:mod:`curve`/:mod:`field`) is now *already*
+limbs-leading — field elements are ``(17, B)`` with batch on the 128-wide
+lane axis — so this kernel no longer needs its own field/curve
+implementation (round 1 duplicated ~380 lines).  It wraps the shared
+:func:`mochi_tpu.crypto.curve.verify_core` in a ``pallas_call`` whose block
+spec pins the whole per-block pipeline (decompress x2 + 64-window
+double-scalar-mul) into VMEM: every intermediate stays on-chip, nothing
+spills to HBM between "ops", and the grid walks the batch in ``block``-lane
+slabs.
 
-* a field element is ``(16, BLOCK)`` int32 — **limbs on sublanes, batch on
-  lanes**, so every elementwise op runs on full 128-lane vectors;
-* the whole pipeline (decompress x2 + 64-window double-scalar-mul) runs in
-  ONE kernel: every intermediate stays in VMEM/registers, nothing spills to
-  HBM between "ops";
-* the per-item table lookups of the windowed ladder become branchless
-  masked-select sums (data-dependent per-lane gathers don't vectorize on the
-  VPU; 16 masked adds do);
-* grid = batch/BLOCK, each program verifying one block of signatures.
+What the kernel changes vs plain XLA:
+
+* **Explicit VMEM residency** — one kernel for the whole pipeline instead
+  of XLA's fusion choices (pallas_guide.md: own the tiling when it matters).
+* **Mosaic-safe column accumulation** — inside the kernel the schoolbook
+  columns are built by unrolled static-slice adds (``field.SKEW_IMPL =
+  "shift"``): Mosaic restricts reshapes that touch the sublane dim, which
+  the XLA path's pad/reshape skewing trick does.
 
 Host-side prep (SHA-512, mod-L, canonicity, bit->digit packing) is shared
 with the XLA path; semantics are bit-identical (differential test:
@@ -26,13 +29,10 @@ build-plan step (e)).
 from __future__ import annotations
 
 import functools
-from typing import Optional, Tuple
-
-import numpy as np
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -41,357 +41,21 @@ from . import field as F
 
 BLOCK = 256  # signatures per grid program (multiple of 128 lanes)
 
-MASK = F.MASK
-RADIX = F.RADIX
-NLIMBS = F.NLIMBS
-
-# ------------------------------------------------------------------ field ops
-# Limbs-leading variants of mochi_tpu.crypto.field: arrays are (16, ...lanes).
-# Same radix-2^16 schoolbook + signed sequential carry semantics, so results
-# match the XLA path limb for limb.
-
-
-def _carry_chain_ll(cols):
-    c = jnp.zeros(cols.shape[1:], dtype=jnp.int32)
-    out = []
-    for k in range(NLIMBS):
-        t = cols[k] + c
-        out.append(t & MASK)
-        c = t >> RADIX
-    return jnp.stack(out, axis=0), c
-
-
-def _fold_carry_ll(limbs, cout):
-    cols = limbs.at[0].add(38 * cout)
-    limbs2, cout2 = _carry_chain_ll(cols)
-    cols3 = limbs2.at[0].add(38 * cout2)
-    limbs3, _ = _carry_chain_ll(cols3)
-    return limbs3
-
-
-def add_ll(a, b):
-    limbs, cout = _carry_chain_ll(a + b)
-    return _fold_carry_ll(limbs, cout)
-
-
-_TWO_P = np.full(NLIMBS, MASK, dtype=np.int32)
-_TWO_P[0] = MASK - 37
-
-
-def sub_ll(a, b):
-    # 2p is added limb-wise as python-int scalars: pallas kernels cannot
-    # capture array constants, but scalar literals are fine.
-    cols = jnp.stack([a[k] + int(_TWO_P[k]) for k in range(NLIMBS)], axis=0) - b
-    limbs, cout = _carry_chain_ll(cols)
-    return _fold_carry_ll(limbs, cout)
-
-
-def neg_ll(a):
-    return sub_ll(jnp.zeros_like(a), a)
-
-
-def mul_ll(a, b):
-    """Schoolbook multiply, anti-diagonal accumulation by slice-shift (the
-    axis-0 mirror of ``field.mul`` — keeps the traced graph ~300 ops/mul so
-    the whole-ladder kernel stays compilable)."""
-    au = a.astype(jnp.uint32)
-    bu = b.astype(jnp.uint32)
-    lanes = a.shape[1:]
-    prod = au[:, None] * bu[None, :]  # (16, 16, lanes)
-    lo = (prod & MASK).astype(jnp.int32)
-    hi = (prod >> RADIX).astype(jnp.int32)
-    cols = jnp.zeros((2 * NLIMBS, *lanes), dtype=jnp.int32)
-    for i in range(NLIMBS):
-        cols = lax.dynamic_update_slice_in_dim(
-            cols,
-            lax.dynamic_slice_in_dim(cols, i, NLIMBS, axis=0) + lo[i],
-            i,
-            axis=0,
-        )
-        cols = lax.dynamic_update_slice_in_dim(
-            cols,
-            lax.dynamic_slice_in_dim(cols, i + 1, NLIMBS, axis=0) + hi[i],
-            i + 1,
-            axis=0,
-        )
-    folded = cols[:NLIMBS] + 38 * cols[NLIMBS:]
-    limbs, cout = _carry_chain_ll(folded)
-    return _fold_carry_ll(limbs, cout)
-
-
-def square_ll(a):
-    return mul_ll(a, a)
-
-
-def mul_small_ll(a, k: int):
-    cols = a * k
-    limbs, cout = _carry_chain_ll(cols)
-    return _fold_carry_ll(limbs, cout)
-
-
-_P_LIMBS = [int(v) for v in F.int_to_limbs(F.P_INT)]
-
-
-def canonical_ll(a):
-    """Unique representative < p (mirrors field.canonical).  The modulus
-    enters as scalar literals (no array constants in pallas kernels)."""
-    limbs, cout = _carry_chain_ll(a)
-    limbs = _fold_carry_ll(limbs, cout)
-
-    def cond_sub_p(x):
-        ge = _ge_p_ll(x)
-        diff, _ = _carry_chain_ll(
-            jnp.stack([x[k] - _P_LIMBS[k] for k in range(NLIMBS)], axis=0)
-        )
-        # ignore borrow: only applied when x >= p
-        return jnp.where(ge, diff, x)
-
-    limbs = cond_sub_p(limbs)
-    limbs = cond_sub_p(limbs)
-    return limbs
-
-
-def _ge_p_ll(x):
-    """x >= p, comparing limbs most-significant first (scalar p limbs)."""
-    gt = jnp.zeros(x.shape[1:], dtype=bool)
-    lt = jnp.zeros(x.shape[1:], dtype=bool)
-    for k in range(NLIMBS - 1, -1, -1):
-        limb_gt = (x[k] > _P_LIMBS[k]) & ~gt & ~lt
-        limb_lt = (x[k] < _P_LIMBS[k]) & ~gt & ~lt
-        gt = gt | limb_gt
-        lt = lt | limb_lt
-    return ~lt
-
-
-def eq_ll(a, b):
-    diff = sub_ll(a, b)
-    can = canonical_ll(diff)
-    return jnp.all(can == 0, axis=0)
-
-
-def is_zero_ll(a):
-    can = canonical_ll(a)
-    return jnp.all(can == 0, axis=0)
-
-
-def select_ll(cond, a, b):
-    return jnp.where(cond[None, ...], a, b)
-
-
-def _square_n_ll(a, n: int):
-    """a^(2^n): n squarings as one fori_loop (graph stays one body)."""
-    return lax.fori_loop(0, n, lambda i, x: square_ll(x), a)
-
-
-def pow_p58_ll(a):
-    """a^((p-5)/8) = a^(2^252 - 3) via the standard ed25519 addition chain
-    (ref10 pow22523 structure): ~12 multiplies + fori_loop squaring runs."""
-    z2 = square_ll(a)  # 2
-    z8 = _square_n_ll(z2, 2)  # 8
-    z9 = mul_ll(a, z8)  # 9
-    z11 = mul_ll(z2, z9)  # 11
-    z22 = square_ll(z11)  # 22
-    z_5_0 = mul_ll(z9, z22)  # 2^5 - 1
-    z_10_0 = mul_ll(_square_n_ll(z_5_0, 5), z_5_0)  # 2^10 - 1
-    z_20_0 = mul_ll(_square_n_ll(z_10_0, 10), z_10_0)  # 2^20 - 1
-    z_40_0 = mul_ll(_square_n_ll(z_20_0, 20), z_20_0)  # 2^40 - 1
-    z_50_0 = mul_ll(_square_n_ll(z_40_0, 10), z_10_0)  # 2^50 - 1
-    z_100_0 = mul_ll(_square_n_ll(z_50_0, 50), z_50_0)  # 2^100 - 1
-    z_200_0 = mul_ll(_square_n_ll(z_100_0, 100), z_100_0)  # 2^200 - 1
-    z_250_0 = mul_ll(_square_n_ll(z_200_0, 50), z_50_0)  # 2^250 - 1
-    return mul_ll(_square_n_ll(z_250_0, 2), a)  # 2^252 - 3
-
-
-# ------------------------------------------------------------------ curve ops
-# Extended twisted-Edwards (X:Y:Z:T), same formulas as mochi_tpu.crypto.curve
-# but over the limbs-leading field ops.  A point is a 4-tuple of (16, lanes).
-
-_D2 = (2 * F.D_INT) % F.P_INT
-
-
-def const_ll(c_int: int, lanes) -> jnp.ndarray:
-    """Field constant materialized from scalar literals (pallas kernels
-    cannot capture array constants; 16 broadcast fills are fine)."""
-    c_limbs = [int(v) for v in F.int_to_limbs(c_int)]
-    return jnp.stack(
-        [jnp.full(lanes, l, dtype=jnp.int32) for l in c_limbs], axis=0
-    )
-
-
-def mul_const_ll(a, c_int: int):
-    return mul_ll(a, const_ll(c_int, a.shape[1:]))
-
-
-def identity_ll(lanes):
-    zero = jnp.zeros((NLIMBS, *lanes), jnp.int32)
-    one = zero.at[0].set(1)
-    return (zero, one, one, zero)
-
-
-def add_pt_ll(p, q):
-    px, py, pz, pt = p
-    qx, qy, qz, qt = q
-    a = mul_ll(sub_ll(py, px), sub_ll(qy, qx))
-    b = mul_ll(add_ll(py, px), add_ll(qy, qx))
-    c = mul_ll(mul_const_ll(pt, _D2), qt)
-    d = mul_ll(add_ll(pz, pz), qz)
-    e = sub_ll(b, a)
-    f = sub_ll(d, c)
-    g = add_ll(d, c)
-    h = add_ll(b, a)
-    return (mul_ll(e, f), mul_ll(g, h), mul_ll(f, g), mul_ll(e, h))
-
-
-def double_pt_ll(p):
-    px, py, pz, _ = p
-    a = square_ll(px)
-    b = square_ll(py)
-    c = mul_small_ll(square_ll(pz), 2)
-    h = add_ll(a, b)
-    e = sub_ll(h, square_ll(add_ll(px, py)))
-    g = sub_ll(a, b)
-    f = add_ll(c, g)
-    return (mul_ll(e, f), mul_ll(g, h), mul_ll(f, g), mul_ll(e, h))
-
-
-def negate_pt_ll(p):
-    px, py, pz, pt = p
-    return (neg_ll(px), py, pz, neg_ll(pt))
-
-
-def madd_niels_ll(p, ypx, ymx, xy2d):
-    px, py, pz, pt = p
-    a = mul_ll(sub_ll(py, px), ymx)
-    b = mul_ll(add_ll(py, px), ypx)
-    c = mul_ll(xy2d, pt)
-    d = add_ll(pz, pz)
-    e = sub_ll(b, a)
-    f = sub_ll(d, c)
-    g = add_ll(d, c)
-    h = add_ll(b, a)
-    return (mul_ll(e, f), mul_ll(g, h), mul_ll(f, g), mul_ll(e, h))
-
-
-def decompress_ll(y, sign):
-    """RFC 8032 decoding, limbs-leading (mirrors curve.decompress)."""
-    lanes = y.shape[1:]
-    yy = square_ll(y)
-    one = jnp.zeros((NLIMBS, *lanes), jnp.int32).at[0].set(1)
-    u = sub_ll(yy, one)
-    v = add_ll(mul_const_ll(yy, F.D_INT), one)
-    v3 = mul_ll(square_ll(v), v)
-    v7 = mul_ll(square_ll(v3), v)
-    x = mul_ll(mul_ll(u, v3), pow_p58_ll(mul_ll(u, v7)))
-    vxx = mul_ll(v, square_ll(x))
-    root_ok = eq_ll(vxx, u)
-    root_neg = eq_ll(vxx, neg_ll(u))
-    x = select_ll(root_neg, mul_const_ll(x, F.SQRT_M1_INT), x)
-    ok = root_ok | root_neg
-    x_can = canonical_ll(x)
-    x_is_zero = is_zero_ll(x)
-    ok = ok & ~(x_is_zero & (sign == 1))
-    flip = (x_can[0] & 1) != sign
-    x = select_ll(flip, neg_ll(x), x)
-    return (x, y, one, mul_ll(x, y)), ok
-
-
-def _select_entry(table, idx, n_entries: int):
-    """Branchless per-lane table lookup: sum of masked entries.
-
-    ``table``: tuple of arrays with entry axis 0 — each ``(n_entries, 16,
-    lanes)``; ``idx``: (lanes,) int32.  Data-dependent per-lane gathers don't
-    vectorize on the VPU; n_entries masked adds do.
-    """
-    out = []
-    for coord in table:
-        acc = jnp.zeros_like(coord[0])
-        for e in range(n_entries):
-            acc = acc + jnp.where((idx == e)[None, ...], coord[e], 0)
-        out.append(acc)
-    return tuple(out)
-
-
-def _small_multiples_ll(p):
-    """[0..15]P stacked on axis 0 — built by 15 chained additions inside ONE
-    fori_loop body (vs 14 unrolled point ops: ~10x smaller traced graph)."""
-    lanes = p[0].shape[1:]
-    table = tuple(
-        jnp.zeros((16, NLIMBS, *lanes), jnp.int32) for _ in range(4)
-    )
-    ident = identity_ll(lanes)
-    table = tuple(
-        t.at[0].set(c) for t, c in zip(table, ident)
-    )
-
-    # chain: entry[k] = entry[k-1] + P, carried as (table, prev_point)
-    def chain(k, carry):
-        table, prev = carry
-        cur = add_pt_ll(prev, p)
-        table = tuple(
-            lax.dynamic_update_index_in_dim(t, c, k, axis=0)
-            for t, c in zip(table, cur)
-        )
-        return (table, cur)
-
-    table, _ = lax.fori_loop(1, 16, chain, (table, ident))
-    return table
-
-
-def verify_block_ll(y_a, sign_a, y_r, sign_r, s_dig, h_dig):
-    """Verify one block: limbs-leading tensors -> bool (lanes,) bitmap.
-
-    Same pipeline as ``curve.verify_prepared`` + ``double_scalar_mul_windowed``:
-    decompress A and R, Q = [S]B + [h](-A) via 64 4-bit windows, compare Q to
-    R projectively.
-    """
-    lanes = y_a.shape[1:]
-    a_point, ok_a = decompress_ll(y_a, sign_a)
-    r_point, ok_r = decompress_ll(y_r, sign_r)
-    na = negate_pt_ll(a_point)
-    a_tab = _small_multiples_ll(na)
-    # basepoint Niels tables, materialized from scalar literals once
-    # (outside the window loop): (16 entries, 16 limbs, lanes)
-    def _b_table(rows):
-        return jnp.stack(
-            [const_ll(F.limbs_to_int(row), lanes) for row in rows], axis=0
-        )
-
-    b_tab = (
-        _b_table(curve._B_TAB_YPX),
-        _b_table(curve._B_TAB_YMX),
-        _b_table(curve._B_TAB_XY2D),
-    )
-
-    def body(i, q):
-        w = 63 - i
-        q = double_pt_ll(double_pt_ll(double_pt_ll(double_pt_ll(q))))
-        hd = lax.dynamic_index_in_dim(h_dig, w, axis=0, keepdims=False)
-        entry = _select_entry(a_tab, hd, 16)
-        q = add_pt_ll(q, entry)
-        sd = lax.dynamic_index_in_dim(s_dig, w, axis=0, keepdims=False)
-        nypx, nymx, nxy2d = _select_entry(b_tab, sd, 16)
-        return madd_niels_ll(q, nypx, nymx, nxy2d)
-
-    q = lax.fori_loop(0, 64, body, identity_ll(lanes))
-    qx, qy, qz, _ = q
-    rx, ry, _, _ = r_point
-    eq_x = eq_ll(qx, mul_ll(rx, qz))
-    eq_y = eq_ll(qy, mul_ll(ry, qz))
-    return ok_a & ok_r & eq_x & eq_y
-
-
-# ------------------------------------------------------------------ kernel
-
 
 def _kernel(y_a_ref, sign_a_ref, y_r_ref, sign_r_ref, s_dig_ref, h_dig_ref, out_ref):
-    bitmap = verify_block_ll(
-        y_a_ref[:, :],
-        sign_a_ref[0, :],
-        y_r_ref[:, :],
-        sign_r_ref[0, :],
-        s_dig_ref[:, :],
-        h_dig_ref[:, :],
-    )
+    prev = F.SKEW_IMPL
+    F.SKEW_IMPL = "shift"  # Mosaic-safe column accumulation (module docstring)
+    try:
+        bitmap = curve.verify_core(
+            y_a_ref[:, :],
+            sign_a_ref[0, :],
+            y_r_ref[:, :],
+            sign_r_ref[0, :],
+            s_dig_ref[:, :],
+            h_dig_ref[:, :],
+        )
+    finally:
+        F.SKEW_IMPL = prev
     out_ref[0, :] = bitmap.astype(jnp.int32)
 
 
@@ -408,8 +72,8 @@ def verify_prepared_pallas(
     """Drop-in for ``curve.verify_prepared`` running the Pallas kernel.
 
     Accepts the same host-prepared ``(batch, ...)`` tensors; transposes to
-    the kernel's limbs-leading layout in XLA (one fused transpose each way),
-    pads the batch to a multiple of ``block`` and grids over blocks.
+    the limbs-leading layout in XLA (one fused transpose each way), pads the
+    batch to a multiple of ``block`` and grids over blocks.
     """
     if interpret is None:
         interpret = _use_interpret()
@@ -424,17 +88,17 @@ def verify_prepared_pallas(
         s_bits = jnp.pad(s_bits, ((0, pad), (0, 0)))
         h_bits = jnp.pad(h_bits, ((0, pad), (0, 0)))
 
-    # (batch, 16) -> (16, batch); digits (batch, 64) -> (64, batch)
+    # (batch, 17) -> (17, batch); bits -> (64, batch) digits
     y_a_t = y_a.T
     y_r_t = y_r.T
-    s_dig = curve.digits4_from_bits(s_bits).T
-    h_dig = curve.digits4_from_bits(h_bits).T
+    s_dig = curve.digits4_from_bits(s_bits.T)
+    h_dig = curve.digits4_from_bits(h_bits.T)
     sign_a_t = sign_a[None, :]
     sign_r_t = sign_r[None, :]
 
     grid = (m // block,)
     limb_spec = pl.BlockSpec(
-        (NLIMBS, block), lambda i: (0, i), memory_space=pltpu.VMEM
+        (F.NLIMBS, block), lambda i: (0, i), memory_space=pltpu.VMEM
     )
     dig_spec = pl.BlockSpec((64, block), lambda i: (0, i), memory_space=pltpu.VMEM)
     sign_spec = pl.BlockSpec((1, block), lambda i: (0, i), memory_space=pltpu.VMEM)

@@ -4,6 +4,9 @@ The TPU verifier must agree bit-for-bit with the CPU fallback on valid,
 forged, and malformed inputs (SURVEY.md §7: "correctness-tested against the
 CPU path"; §4 "validity bitmap on mixed valid/forged batches").  Field
 arithmetic is additionally checked against python bignums.
+
+Layout note (round 2): field elements are limbs-leading ``(17, B)`` —
+batch on the trailing lane axis (see ``field.py`` module docstring).
 """
 
 import random
@@ -18,20 +21,25 @@ from mochi_tpu.crypto import field as F
 from mochi_tpu.crypto.keys import generate_keypair, verify as cpu_verify
 from mochi_tpu.verifier.spi import VerifyItem
 
+RANGE = 1 << 255  # the limb representation covers [0, 2^255)
+
+
+def _pack(ints):
+    """Python ints -> limbs-leading (17, B) device array."""
+    return jnp.asarray(np.stack([F.int_to_limbs(x) for x in ints], axis=-1))
+
 
 class TestField:
     def _rand_pairs(self, n=8, seed=1):
         rng = random.Random(seed)
-        xs = [rng.randrange(0, 1 << 256) for _ in range(n)]
-        ys = [rng.randrange(0, 1 << 256) for _ in range(n)]
-        A = jnp.asarray(np.stack([F.int_to_limbs(x) for x in xs]))
-        B = jnp.asarray(np.stack([F.int_to_limbs(y) for y in ys]))
-        return xs, ys, A, B
+        xs = [rng.randrange(0, RANGE) for _ in range(n)]
+        ys = [rng.randrange(0, RANGE) for _ in range(n)]
+        return xs, ys, _pack(xs), _pack(ys)
 
     def _assert_mod_eq(self, got, expect_ints):
         got_ints = F.limbs_to_int_batch(np.asarray(got))
         arr = np.asarray(got)
-        assert arr.min() >= 0 and arr.max() <= F.MASK  # loose-reduction invariant
+        assert arr.min() >= 0 and arr.max() <= F.LOOSE  # loose-carry invariant
         assert [g % F.P_INT for g in got_ints] == [e % F.P_INT for e in expect_ints]
 
     def test_add_sub_mul(self):
@@ -41,6 +49,20 @@ class TestField:
         self._assert_mod_eq(F.mul(A, B), [x * y for x, y in zip(xs, ys)])
         self._assert_mod_eq(F.square(A), [x * x for x in xs])
         self._assert_mod_eq(F.neg(A), [-x for x in xs])
+        self._assert_mod_eq(F.mul_small(A, 2), [2 * x for x in xs])
+        self._assert_mod_eq(F.mul_small(A, 977), [977 * x for x in xs])
+
+    def test_mul_skew_impls_agree(self):
+        xs, ys, A, B = self._rand_pairs(seed=3)
+        prev = F.SKEW_IMPL
+        try:
+            F.SKEW_IMPL = "reshape"
+            r1 = np.asarray(F.mul(A, B))
+            F.SKEW_IMPL = "shift"
+            r2 = np.asarray(F.mul(A, B))
+        finally:
+            F.SKEW_IMPL = prev
+        assert (r1 == r2).all()
 
     def test_pow_invert_canonical(self):
         xs, _, A, _ = self._rand_pairs(n=4, seed=2)
@@ -50,13 +72,31 @@ class TestField:
         can = F.limbs_to_int_batch(np.asarray(F.canonical(A)))
         assert can == [x % p for x in xs]
 
+    def test_loose_chains_stay_bounded(self):
+        """Long op chains must preserve the loose-limb invariant."""
+        xs, ys, A, B = self._rand_pairs(seed=5)
+        acc, acc_int = A, list(xs)
+        for i in range(20):
+            acc = F.mul(F.add(acc, B), F.sub(acc, A))
+            acc_int = [
+                ((a + y) * (a - x)) % F.P_INT
+                for a, x, y in zip(acc_int, xs, ys)
+            ]
+            arr = np.asarray(acc)
+            assert arr.min() >= 0 and arr.max() <= F.LOOSE
+        self._assert_mod_eq(acc, acc_int)
+
     def test_edge_values(self):
-        # 0, 1, p-1, p, 2p (aliases of 0), 2^256-1
-        vals = [0, 1, F.P_INT - 1, F.P_INT, 2 * F.P_INT, (1 << 256) - 1]
-        A = jnp.asarray(np.stack([F.int_to_limbs(v) for v in vals]))
+        # 0, 1, p-1, p, p+17 (alias of 17), 2^255-1 (max representable)
+        vals = [0, 1, F.P_INT - 1, F.P_INT, F.P_INT + 17, RANGE - 1]
+        A = _pack(vals)
         can = F.limbs_to_int_batch(np.asarray(F.canonical(A)))
         assert can == [v % F.P_INT for v in vals]
         self._assert_mod_eq(F.mul(A, A), [v * v for v in vals])
+
+    def test_int_to_limbs_rejects_oversize(self):
+        with pytest.raises(AssertionError):
+            F.int_to_limbs(1 << 255)
 
 
 class TestBatchVerify:
@@ -121,3 +161,36 @@ class TestBatchVerify:
         kp = generate_keypair()
         items = [VerifyItem(kp.public_key, b"m", kp.sign(b"m"))]
         assert list(backend(items)) == [True]
+
+    def test_background_compile_failure_lands_in_failed(self):
+        """ADVICE r1: a crash inside the background bucket compile must mark
+        the bucket failed (not die with NameError and respawn threads)."""
+        import threading
+
+        backend = BV.JaxBatchBackend()
+        backend._ready.add(16)  # pretend a small bucket is compiled
+        done = threading.Event()
+        orig = BV.verify_batch
+
+        def boom(items, device=None, bucket=None):
+            if bucket is None and len(items) > 16:
+                raise RuntimeError("simulated compile failure")
+            return orig(items, device=device, bucket=bucket)
+
+        BV.verify_batch = boom
+        try:
+            kp = generate_keypair()
+            items = [VerifyItem(kp.public_key, b"m", kp.sign(b"m"))] * 24
+            out = backend(items)  # served chunked via bucket 16
+            assert list(out) == [True] * 24
+            for _ in range(100):
+                with backend._lock:
+                    if 32 in backend._failed and 32 not in backend._compiling:
+                        done.set()
+                        break
+                import time
+
+                time.sleep(0.05)
+            assert done.is_set(), "failed bucket never recorded"
+        finally:
+            BV.verify_batch = orig
