@@ -1,18 +1,29 @@
 """Headline benchmark: batched Ed25519 verify throughput on the JAX device.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 
 The metric is device signature-verification throughput (sigs/sec), peak over
-several batch sizes (BASELINE.json config 2 range).  ``vs_baseline`` is the
-speedup over the reference-analog CPU path measured in the same run — one
-OpenSSL (via ``cryptography``) Ed25519 verify per signature on this host,
-single-thread, the stand-in for the reference's intended BouncyCastle
-verifier (the reference itself never signs: ``MochiProtocol.proto:123`` TODO,
-SURVEY.md preamble).
+several batch sizes (BASELINE.json config 2 range) and over both device
+implementations (XLA and the Pallas kernel — the per-impl table ships in the
+"impls" key).  ``vs_baseline`` is the speedup over the reference-analog CPU
+path measured in the same run — one OpenSSL (via ``cryptography``) Ed25519
+verify per signature on this host, single-thread, the stand-in for the
+reference's intended BouncyCastle verifier (the reference itself never
+signs: ``MochiProtocol.proto:123`` TODO, SURVEY.md preamble).  The honest
+fleet denominator — all host cores verifying in parallel — is also reported
+(``cpu_allcores_sigs_per_sec`` / ``vs_cpu_allcores``), per VERDICT.md round-1
+weak #6.
 
-Robustness: device discovery/compile runs under a watchdog; if the TPU
-plugin wedges (tunnel loss), the benchmark re-executes itself on the CPU
-backend so the driver still gets a measurement (flagged via "platform").
+Robustness (VERDICT.md round-1 weak #1): the measurement always runs in a
+fresh subprocess; the parent retries N times with backend re-init before
+falling back to the CPU backend, and a fallback is flagged LOUDLY
+(``tpu_unreachable: true``) instead of being passed off as the headline.
+The persistent XLA compilation cache (.jax_cache) is wired in so driver
+re-runs skip the 20-60 s per-bucket compiles.
+
+MFU accounting (VERDICT.md round-1 weak #4): ops/signature from XLA's own
+``cost_analysis`` on the compiled executable, peak utilization against a
+documented nominal VPU peak.
 """
 
 from __future__ import annotations
@@ -21,10 +32,18 @@ import json
 import os
 import subprocess
 import sys
-import threading
 import time
 
-WATCHDOG_ENV = "MOCHI_BENCH_CPU_FALLBACK"
+_REPO = os.path.dirname(os.path.abspath(__file__))
+_CACHE_DIR = os.path.join(_REPO, ".jax_cache")
+
+# Nominal per-chip vector-unit peak for MFU accounting, int32 ops/s.
+# TPU v5e: 8 VPU sublanes x 128 lanes x ~1.74 GHz x ~2 ALUs ~= 3.6e12; we use
+# the conservative single-issue figure 1.8e12 (so reported MFU is an upper
+# bound on how much headroom remains, not a flattering lower one).  The
+# Ed25519 verifier is pure int32 VPU work — the MXU plays no part — so VPU
+# peak is the right denominator.
+VPU_PEAK_INT_OPS = 1.8e12
 
 
 def _measure() -> dict:
@@ -37,12 +56,9 @@ def _measure() -> dict:
     from mochi_tpu.verifier.spi import VerifyItem
 
     dev = jax.devices()[0]
-    fn = jax.jit(verify_prepared)
     kp = keys.generate_keypair()
 
-    best_rate = 0.0
-    best = None
-    for batch in (1024, 4096, 16384):
+    def prepared(batch):
         items = []
         for i in range(batch):
             msg = b"bench message %d" % i
@@ -50,26 +66,101 @@ def _measure() -> dict:
         y_a, sign_a, y_r, sign_r, s_bits, h_bits, pre_ok = batch_verify.prepare(items)
         assert pre_ok.all()
         args = tuple(
-            jax.device_put(a, dev) for a in (y_a, sign_a, y_r, sign_r, s_bits, h_bits)
+            jax.device_put(a, dev)
+            for a in (y_a, sign_a, y_r, sign_r, s_bits, h_bits)
         )
+        return items, args
+
+    # 4096 is the VMEM-residency peak (batch_verify.MAX_BUCKET); 8192/16384
+    # document the spill regression the production path avoids by chunking.
+    batches = (1024, 2048, 4096, 8192, 16384)
+    impls = {}
+
+    # ---- XLA path -------------------------------------------------------
+    fn = jax.jit(verify_prepared)
+    xla = {"per_batch": {}}
+    flops_per_sig = None
+    for batch in batches:
+        items, args = prepared(batch)
+        t0 = time.perf_counter()
         out = jax.block_until_ready(fn(*args))  # compile + warmup
+        compile_s = time.perf_counter() - t0
         assert np.asarray(out).all()
+        if flops_per_sig is None:
+            try:
+                cost = fn.lower(*args).compile().cost_analysis()
+                if isinstance(cost, list):
+                    cost = cost[0]
+                flops_per_sig = float(cost.get("flops", 0.0)) / batch
+            except Exception:
+                flops_per_sig = 0.0
         times = []
         for _ in range(5):
             t0 = time.perf_counter()
             jax.block_until_ready(fn(*args))
             times.append(time.perf_counter() - t0)
         rate = batch / min(times)
-        if rate > best_rate:
-            best_rate = rate
-            best = {"batch": batch, "ms": round(min(times) * 1e3, 2)}
+        xla["per_batch"][batch] = {
+            "sigs_per_sec": round(rate, 1),
+            "ms": round(min(times) * 1e3, 2),
+            "compile_s": round(compile_s, 1),
+        }
+    xla["best"] = max(
+        ((b, v["sigs_per_sec"]) for b, v in xla["per_batch"].items()),
+        key=lambda kv: kv[1],
+    )
+    impls["xla"] = xla
 
-    # CPU baseline: sequential OpenSSL verifies (sampled, extrapolated)
+    # ---- Pallas kernel --------------------------------------------------
+    if dev.platform == "tpu":
+        try:
+            from mochi_tpu.crypto.pallas_verify import verify_prepared_pallas
+
+            pal = {"per_batch": {}}
+            for batch in batches:
+                items, args = prepared(batch)
+                t0 = time.perf_counter()
+                out = jax.block_until_ready(verify_prepared_pallas(*args))
+                compile_s = time.perf_counter() - t0
+                assert np.asarray(out).all()
+                times = []
+                for _ in range(5):
+                    t0 = time.perf_counter()
+                    jax.block_until_ready(verify_prepared_pallas(*args))
+                    times.append(time.perf_counter() - t0)
+                rate = batch / min(times)
+                pal["per_batch"][batch] = {
+                    "sigs_per_sec": round(rate, 1),
+                    "ms": round(min(times) * 1e3, 2),
+                    "compile_s": round(compile_s, 1),
+                }
+            pal["best"] = max(
+                ((b, v["sigs_per_sec"]) for b, v in pal["per_batch"].items()),
+                key=lambda kv: kv[1],
+            )
+            impls["pallas"] = pal
+        except Exception as exc:  # prove-or-kill: record, don't crash
+            impls["pallas"] = {"error": f"{type(exc).__name__}: {exc}"[:500]}
+
+    best_impl, (best_batch, best_rate) = max(
+        ((name, i["best"]) for name, i in impls.items() if "best" in i),
+        key=lambda kv: kv[1][1],
+    )
+
+    # ---- CPU baselines --------------------------------------------------
+    items, _ = prepared(1024)
     sample = items[:256]
     t0 = time.perf_counter()
     for it in sample:
         assert keys.verify(it.public_key, it.message, it.signature)
     cpu_rate = len(sample) / (time.perf_counter() - t0)
+
+    ncores = os.cpu_count() or 1
+    cpu_allcores = _allcores_baseline(sample, ncores)
+
+    mfu = None
+    if flops_per_sig:
+        mfu = best_rate * flops_per_sig / VPU_PEAK_INT_OPS
 
     return {
         "metric": "ed25519_batch_verify_throughput",
@@ -77,46 +168,96 @@ def _measure() -> dict:
         "unit": "sigs/sec",
         "vs_baseline": round(best_rate / cpu_rate, 3),
         "platform": dev.platform,
-        "best_batch": best["batch"],
-        "best_ms": best["ms"],
+        "impl": best_impl,
+        "best_batch": best_batch,
+        "impls": impls,
         "cpu_openssl_sigs_per_sec": round(cpu_rate, 1),
+        "cpu_allcores_sigs_per_sec": round(cpu_allcores, 1),
+        "vs_cpu_allcores": round(best_rate / cpu_allcores, 3),
+        "cpu_cores": ncores,
+        "ops_per_sig_xla_cost_analysis": round(flops_per_sig or 0.0),
+        "mfu_vs_vpu_peak": round(mfu, 4) if mfu is not None else None,
+        "vpu_peak_int_ops_assumed": VPU_PEAK_INT_OPS,
     }
 
 
-def _device_alive(timeout_s: float = 90.0) -> bool:
-    """True if jax backend initialization completes within the watchdog."""
-    result = {}
+def _allcores_baseline(sample, ncores: int) -> float:
+    """OpenSSL verify rate with every host core busy (process pool)."""
+    import multiprocessing as mp
 
-    def probe():
-        try:
-            import jax
+    payload = [(it.public_key, it.message, it.signature) for it in sample]
+    try:
+        ctx = mp.get_context("fork")
+        with ctx.Pool(ncores) as pool:
+            t0 = time.perf_counter()
+            pool.map(_verify_chunk, [payload] * ncores)
+            dt = time.perf_counter() - t0
+        return len(payload) * ncores / dt
+    except Exception:
+        return 0.0
 
-            result["n"] = len(jax.devices())
-        except Exception:
-            result["n"] = 0
 
-    t = threading.Thread(target=probe, daemon=True)
-    t.start()
-    t.join(timeout_s)
-    return result.get("n", 0) > 0
+def _verify_chunk(payload):
+    from mochi_tpu.crypto import keys
+
+    for pk, msg, sig in payload:
+        keys.verify(pk, msg, sig)
+    return len(payload)
+
+
+def _child() -> None:
+    import jax
+
+    if os.environ.get("MOCHI_BENCH_FORCE_CPU") == "1":
+        jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+    print("BENCH_JSON " + json.dumps(_measure()), flush=True)
+
+
+def _run_child(force_cpu: bool, timeout_s: float):
+    env = dict(os.environ)
+    if force_cpu:
+        env.update({"MOCHI_BENCH_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu",
+                    "PALLAS_AXON_POOL_IPS": ""})
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child"],
+            env=env, cwd=_REPO, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return None, "timeout"
+    out = proc.stdout.decode(errors="replace")
+    for line in reversed(out.splitlines()):
+        if line.startswith("BENCH_JSON "):
+            return json.loads(line[len("BENCH_JSON "):]), None
+    return None, f"rc={proc.returncode} tail={out[-1500:]}"
 
 
 def main() -> None:
-    if os.environ.get(WATCHDOG_ENV) != "1" and not _device_alive():
-        # TPU plugin wedged (e.g. tunnel down): re-exec on the CPU backend so
-        # the driver still gets a number.  Can't be done in-process — the
-        # hung backend initialization poisons this interpreter.
-        env = dict(os.environ)
-        env.update(
-            {
-                WATCHDOG_ENV: "1",
-                "JAX_PLATFORMS": "cpu",
-                "PALLAS_AXON_POOL_IPS": "",
-            }
-        )
-        proc = subprocess.run([sys.executable, os.path.abspath(__file__)], env=env)
-        sys.exit(proc.returncode)
-    print(json.dumps(_measure()))
+    if len(sys.argv) > 1 and sys.argv[1] == "--child":
+        _child()
+        return
+    errors = []
+    # Two TPU attempts (fresh backend init each) before conceding the chip.
+    for attempt in range(2):
+        result, err = _run_child(force_cpu=False, timeout_s=1200)
+        if result is not None:
+            print(json.dumps(result))
+            return
+        errors.append(f"attempt{attempt}: {err}")
+    result, err = _run_child(force_cpu=True, timeout_s=1800)
+    if result is None:
+        print(json.dumps({
+            "metric": "ed25519_batch_verify_throughput", "value": 0,
+            "unit": "sigs/sec", "vs_baseline": 0, "error": "; ".join(errors + [str(err)]),
+        }))
+        sys.exit(1)
+    # LOUD: this number is a CPU-backend fallback, not the TPU story.
+    result["tpu_unreachable"] = True
+    result["tpu_errors"] = errors
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -39,6 +39,12 @@ from ..verifier.spi import VerifyItem
 LOG = logging.getLogger(__name__)
 
 MIN_BUCKET = 16
+# Largest single device launch.  Measured on v5e (BENCH r2): 4096 lanes is
+# the throughput peak — the per-item small-multiples tables are ~4.4 MB per
+# coordinate at 4096 lanes and spill VMEM beyond that (16384 halves the
+# rate, 65536 is 6x slower).  Bigger requests are chunked at this size, so
+# rate stays flat instead of regressing.
+MAX_BUCKET = 4096
 
 
 def _impl() -> str:
@@ -117,6 +123,11 @@ def verify_batch(
     """
     if not items:
         return []
+    if len(items) > MAX_BUCKET and bucket is None:
+        out: List[bool] = []
+        for i in range(0, len(items), MAX_BUCKET):
+            out.extend(verify_batch(items[i : i + MAX_BUCKET], device=device))
+        return out
     y_a, sign_a, y_r, sign_r, s_bits, h_bits, pre_ok = prepare(items)
     n = len(items)
     m = _bucket_size(n) if bucket is None else bucket

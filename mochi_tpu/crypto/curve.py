@@ -38,6 +38,13 @@ from jax import lax
 
 from . import field as F
 
+# Mosaic-safe mode (set by the Pallas kernel wrapper): Mosaic TC lowering has
+# no dynamic_slice on values, so the two data-dependent indexing sites in the
+# ladder (per-window digit extraction, per-slot table write) switch to
+# branchless masked forms, and the small-multiples table is built by 15
+# unrolled additions instead of a fori_loop of dynamic updates.
+MOSAIC_SAFE = False
+
 
 class Point(NamedTuple):
     """Extended coordinates (X : Y : Z : T), x=X/Z, y=Y/Z, T=XY/Z.
@@ -214,9 +221,21 @@ def select_entry(table, idx: jnp.ndarray, n_entries: int):
 
 def _small_multiples_table(p: Point):
     """[0..15]P stacked on axis 0 — built by 15 chained additions inside ONE
-    fori_loop body (vs 14 unrolled point ops: ~10x smaller traced graph)."""
+    fori_loop body (vs 14 unrolled point ops: ~10x smaller traced graph).
+
+    Mosaic-safe mode unrolls the chain and stacks at the end (no dynamic
+    updates); the extra ~135 traced muls are acceptable inside the kernel.
+    """
     lanes = p.x.shape[1:]
     ident = identity(lanes)
+    if MOSAIC_SAFE:
+        pts = [ident]
+        for _ in range(15):
+            pts.append(add(pts[-1], p))
+        return tuple(
+            jnp.stack([getattr(pt, c) for pt in pts], axis=0)
+            for c in ("x", "y", "z", "t")
+        )
     table = tuple(
         jnp.zeros((16, F.NLIMBS, *lanes), jnp.int32).at[0].set(c) for c in ident
     )
@@ -235,28 +254,44 @@ def _small_multiples_table(p: Point):
 
 
 def double_scalar_mul_windowed(
-    s_dig: jnp.ndarray, p_dig: jnp.ndarray, p_point: Point
+    s_dig: jnp.ndarray, p_dig: jnp.ndarray, p_point: Point, b_tab=None
 ) -> Point:
     """[s]B + [p]P with 4-bit windows, msb-first over 64 windows.
 
     ``s_dig``/``p_dig``: (64, lanes) base-16 digits (little-endian windows).
+    ``b_tab``: optional externally-supplied Niels basepoint tables — three
+    (16, 17[, 1]) arrays.  Pallas kernels pass them as operands (Mosaic
+    rejects closure-captured array constants); the XLA path leaves this None
+    and embeds them as literals.
     """
     lanes = s_dig.shape[1:]
     a_tab = _small_multiples_table(p_point)
-    b_tab = (
-        jnp.asarray(_B_TAB_YPX)[..., None] if lanes else jnp.asarray(_B_TAB_YPX),
-        jnp.asarray(_B_TAB_YMX)[..., None] if lanes else jnp.asarray(_B_TAB_YMX),
-        jnp.asarray(_B_TAB_XY2D)[..., None] if lanes else jnp.asarray(_B_TAB_XY2D),
-    )
+    if b_tab is None:
+        b_tab = (
+            jnp.asarray(_B_TAB_YPX)[..., None] if lanes else jnp.asarray(_B_TAB_YPX),
+            jnp.asarray(_B_TAB_YMX)[..., None] if lanes else jnp.asarray(_B_TAB_YMX),
+            jnp.asarray(_B_TAB_XY2D)[..., None] if lanes else jnp.asarray(_B_TAB_XY2D),
+        )
+
+    if MOSAIC_SAFE:
+        # No dynamic_slice in Mosaic: extract window w's digits with a
+        # branchless masked reduce over the window axis.
+        win_iota = lax.broadcasted_iota(jnp.int32, (64, *lanes), 0)
+
+        def digit_at(dig, w):
+            return jnp.sum(jnp.where(win_iota == w, dig, 0), axis=0)
+
+    else:
+
+        def digit_at(dig, w):
+            return lax.dynamic_index_in_dim(dig, w, axis=0, keepdims=False)
 
     def body(i, q):
         w = 63 - i
         q = double(double(double(double(Point(*q)))))
-        pd = lax.dynamic_index_in_dim(p_dig, w, axis=0, keepdims=False)
-        entry = Point(*select_entry(a_tab, pd, 16))
+        entry = Point(*select_entry(a_tab, digit_at(p_dig, w), 16))
         q = add(q, entry)
-        sd = lax.dynamic_index_in_dim(s_dig, w, axis=0, keepdims=False)
-        nypx, nymx, nxy2d = select_entry(b_tab, sd, 16)
+        nypx, nymx, nxy2d = select_entry(b_tab, digit_at(s_dig, w), 16)
         return tuple(madd_niels(q, nypx, nymx, nxy2d))
 
     q = lax.fori_loop(0, 64, body, tuple(identity(lanes)))
@@ -270,11 +305,13 @@ def verify_core(
     sign_r: jnp.ndarray,
     s_dig: jnp.ndarray,
     h_dig: jnp.ndarray,
+    b_tab=None,
 ) -> jnp.ndarray:
     """Limbs-leading batched verify -> validity bitmap (lanes,) bool.
 
     Inputs: ``y_a``/``y_r`` (17, lanes) limb tensors; ``sign_*`` (lanes,);
-    ``s_dig``/``h_dig`` (64, lanes) base-16 scalar digits.
+    ``s_dig``/``h_dig`` (64, lanes) base-16 scalar digits; ``b_tab`` see
+    :func:`double_scalar_mul_windowed`.
 
     Checks the cofactorless equation [S]B == R + [h]A (as OpenSSL/the CPU
     path does), rearranged to Q := [S]B + [h](-A), Q == R, compared
@@ -285,7 +322,7 @@ def verify_core(
     """
     a_point, ok_a = decompress(y_a, sign_a)
     r_point, ok_r = decompress(y_r, sign_r)
-    q = double_scalar_mul_windowed(s_dig, h_dig, negate(a_point))
+    q = double_scalar_mul_windowed(s_dig, h_dig, negate(a_point), b_tab=b_tab)
     eq_x = F.eq(q.x, F.mul(r_point.x, q.z))
     eq_y = F.eq(q.y, F.mul(r_point.y, q.z))
     return ok_a & ok_r & eq_x & eq_y

@@ -128,7 +128,7 @@ def _limb_vec(np_limbs: np.ndarray, lanes=()) -> jnp.ndarray:
         return jnp.stack(
             [jnp.full(lanes, int(l), dtype=jnp.int32) for l in np_limbs], axis=0
         )
-    return jnp.asarray(np_limbs).reshape(NLIMBS, *([1] * max(len(lanes), 1)))
+    return jnp.asarray(np_limbs).reshape(NLIMBS, *([1] * len(lanes)))
 
 
 def zeros(lanes) -> jnp.ndarray:
@@ -136,7 +136,12 @@ def zeros(lanes) -> jnp.ndarray:
 
 
 def one(lanes) -> jnp.ndarray:
-    return zeros(lanes).at[0].set(1)
+    # concat, not .at[0].set: an indexed update lowers to scatter, which
+    # Mosaic has no TC lowering for; XLA folds both forms identically.
+    return jnp.concatenate(
+        [jnp.ones((1, *lanes), jnp.int32), jnp.zeros((NLIMBS - 1, *lanes), jnp.int32)],
+        axis=0,
+    )
 
 
 # ------------------------------------------------------------------- carries
@@ -205,7 +210,7 @@ _TWO_P_LIMBS = (2 * _P_LIMBS_NP).astype(np.int32)
 def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """a - b mod p.  t = a + 2p - b: limbwise 32634 <= t <= 98398 <= 2^17,
     nonnegative because every 2p limb (>= 65498) exceeds any loose limb."""
-    two_p = jnp.asarray(_TWO_P_LIMBS).reshape(NLIMBS, *([1] * (a.ndim - 1)))
+    two_p = _limb_vec(_TWO_P_LIMBS, a.shape[1:])
     return _carry1(a + (two_p - b))
 
 
@@ -303,10 +308,13 @@ def canonical(a: jnp.ndarray) -> jnp.ndarray:
     < 2^255 < p + 20; one conditional subtract of p settles it.
     """
     limbs, cout = _carry_chain(a)
-    limbs = limbs.at[0].add(19 * cout)
+    # limb-0 += 19*cout via concat (scatter-free: see one())
+    limbs = limbs + jnp.concatenate(
+        [(19 * cout)[None], jnp.zeros((NLIMBS - 1, *cout.shape), jnp.int32)], axis=0
+    )
     limbs, _ = _carry_chain(limbs)
 
-    p_vec = jnp.asarray(_P_LIMBS_NP).reshape(NLIMBS, *([1] * (a.ndim - 1)))
+    p_vec = _limb_vec(_P_LIMBS_NP, a.shape[1:])
     diff, borrow = _carry_chain(limbs - p_vec)
     return jnp.where((borrow >= 0), diff, limbs)
 

@@ -42,10 +42,25 @@ from . import field as F
 BLOCK = 256  # signatures per grid program (multiple of 128 lanes)
 
 
-def _kernel(y_a_ref, sign_a_ref, y_r_ref, sign_r_ref, s_dig_ref, h_dig_ref, out_ref):
-    prev = F.SKEW_IMPL
-    F.SKEW_IMPL = "shift"  # Mosaic-safe column accumulation (module docstring)
+def _kernel(
+    y_a_ref, sign_a_ref, y_r_ref, sign_r_ref, s_dig_ref, h_dig_ref,
+    ypx_ref, ymx_ref, xy2d_ref, out_ref,
+):
+    prev_const, prev_safe = F.CONST_MODE, curve.MOSAIC_SAFE
+    # Mosaic-safe modes: no closure-captured array constants (limb constants
+    # materialize as per-limb scalar fills; the Niels basepoint tables arrive
+    # as kernel operands ypx/ymx/xy2d instead of literals) and no
+    # dynamic_slice (masked digit extraction + unrolled table build —
+    # curve.MOSAIC_SAFE).  The pad/reshape column skew stays: the reshapes
+    # only touch leading (untiled) axes plus lane-dim splits Mosaic accepts.
+    F.CONST_MODE = "scalars"
+    curve.MOSAIC_SAFE = True
     try:
+        b_tab = (
+            ypx_ref[:, :][..., None],
+            ymx_ref[:, :][..., None],
+            xy2d_ref[:, :][..., None],
+        )
         bitmap = curve.verify_core(
             y_a_ref[:, :],
             sign_a_ref[0, :],
@@ -53,9 +68,11 @@ def _kernel(y_a_ref, sign_a_ref, y_r_ref, sign_r_ref, s_dig_ref, h_dig_ref, out_
             sign_r_ref[0, :],
             s_dig_ref[:, :],
             h_dig_ref[:, :],
+            b_tab=b_tab,
         )
     finally:
-        F.SKEW_IMPL = prev
+        F.CONST_MODE = prev_const
+        curve.MOSAIC_SAFE = prev_safe
     out_ref[0, :] = bitmap.astype(jnp.int32)
 
 
@@ -102,12 +119,20 @@ def verify_prepared_pallas(
     )
     dig_spec = pl.BlockSpec((64, block), lambda i: (0, i), memory_space=pltpu.VMEM)
     sign_spec = pl.BlockSpec((1, block), lambda i: (0, i), memory_space=pltpu.VMEM)
+    # Niels basepoint tables: same (16, 17) block for every grid program.
+    tab_spec = pl.BlockSpec((16, F.NLIMBS), lambda i: (0, 0), memory_space=pltpu.VMEM)
     out = pl.pallas_call(
         _kernel,
         out_shape=jax.ShapeDtypeStruct((1, m), jnp.int32),
         grid=grid,
-        in_specs=[limb_spec, sign_spec, limb_spec, sign_spec, dig_spec, dig_spec],
+        in_specs=[limb_spec, sign_spec, limb_spec, sign_spec, dig_spec, dig_spec,
+                  tab_spec, tab_spec, tab_spec],
         out_specs=pl.BlockSpec((1, block), lambda i: (0, i), memory_space=pltpu.VMEM),
         interpret=interpret,
-    )(y_a_t, sign_a_t, y_r_t, sign_r_t, s_dig, h_dig)
+    )(
+        y_a_t, sign_a_t, y_r_t, sign_r_t, s_dig, h_dig,
+        jnp.asarray(curve._B_TAB_YPX),
+        jnp.asarray(curve._B_TAB_YMX),
+        jnp.asarray(curve._B_TAB_XY2D),
+    )
     return out[0, :n].astype(bool)

@@ -329,6 +329,13 @@ static PyObject *rd_value(Rd *r, int depth) {
             PyErr_SetString(PyExc_ValueError, "mcode: list guard exceeded");
             return NULL;
         }
+        /* Every element costs >= 1 byte (its tag), so a count larger than
+         * the remaining input is guaranteed truncated.  Fail fast instead of
+         * preallocating attacker-controlled (pre-authentication) memory. */
+        if ((Py_ssize_t)n > r->len - r->pos) {
+            PyErr_SetString(PyExc_ValueError, "mcode: truncated list");
+            return NULL;
+        }
         PyObject *list = PyList_New((Py_ssize_t)n);
         if (!list) return NULL;
         for (Py_ssize_t i = 0; i < (Py_ssize_t)n; i++) {

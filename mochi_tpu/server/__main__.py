@@ -46,6 +46,17 @@ async def amain(args) -> None:
         # keeps it out of the first client's commit latency) — READY is only
         # printed once the verifier can serve.
         verifier = TpuBatchVerifier(warmup_buckets=(16,))
+    elif args.verifier.startswith("remote:"):
+        # Shared TPU sidecar: one mochi_tpu.verifier.service process owns the
+        # chip; every replica ships its signature batches there (the north
+        # star's sidecar boundary — a chip has one owner process).
+        from ..verifier.service import RemoteVerifier
+
+        target = args.verifier[len("remote:"):]
+        host, _, port = target.rpartition(":")
+        if not host or not port.isdigit():
+            raise SystemExit(f"--verifier remote:<host>:<port> (got {args.verifier!r})")
+        verifier = RemoteVerifier(host, int(port))
     snapshot_path = None
     if args.data_dir:
         snapshot_path = str(Path(args.data_dir) / f"{args.server_id}.snapshot")
@@ -69,7 +80,10 @@ async def amain(args) -> None:
     if args.admin_port is not None:
         from ..admin import AdminServer
 
-        admin = AdminServer(replica, host=args.host or "127.0.0.1", port=args.admin_port)
+        # Deliberately NOT args.host: --host 0.0.0.0 opens the replica
+        # protocol port, but the unauthenticated admin endpoints stay on
+        # loopback unless --admin-host explicitly widens them.
+        admin = AdminServer(replica, host=args.admin_host, port=args.admin_port)
         await admin.start()
         logging.info("admin shell on port %s", admin.bound_port)
     logging.info("replica %s serving on %s:%s", args.server_id, replica.rpc.host, replica.bound_port)
@@ -88,12 +102,22 @@ def main(argv=None) -> None:
     parser.add_argument("--server-id", required=True)
     parser.add_argument("--seed-file", required=True)
     parser.add_argument("--host", default=None, help="bind host override (e.g. 0.0.0.0)")
-    parser.add_argument("--verifier", choices=("cpu", "tpu"), default="cpu")
+    parser.add_argument(
+        "--verifier",
+        default="cpu",
+        help="cpu | tpu | remote:<host>:<port> (shared verifier service)",
+    )
     parser.add_argument(
         "--admin-port",
         type=int,
         default=None,
         help="serve the HTTP admin shell (/status, /metrics) on this port",
+    )
+    parser.add_argument(
+        "--admin-host",
+        default="127.0.0.1",
+        help="bind host for the admin shell (kept separate from --host so a "
+        "wide replica bind does not expose the unauthenticated admin API)",
     )
     parser.add_argument(
         "--data-dir",

@@ -86,6 +86,7 @@ class MochiReplica:
         self.snapshot_path = snapshot_path
         self.snapshot_interval_s = snapshot_interval_s
         self._snapshot_task: Optional[asyncio.Task] = None
+        self._snapshot_write_fut: Optional[asyncio.Future] = None
 
     # ----------------------------------------------------------------- boot
 
@@ -111,16 +112,30 @@ class MochiReplica:
                 # could tear a StoreValue mid-_apply); only the fsync'd file
                 # write goes to the executor.
                 blob = persistence.snapshot_bytes(self.store)
-                await asyncio.get_running_loop().run_in_executor(
+                self._snapshot_write_fut = asyncio.get_running_loop().run_in_executor(
                     None, persistence.write_snapshot_blob, blob, self.snapshot_path
                 )
+                await self._snapshot_write_fut
                 self.metrics.mark("replica.snapshots")
             except Exception:
                 LOG.exception("periodic snapshot failed")
 
     async def close(self) -> None:
         if self._snapshot_task is not None:
+            # Await the cancelled loop AND any in-flight executor write: an
+            # unawaited periodic os.replace could otherwise land AFTER the
+            # final snapshot below, clobbering the freshest state.
             self._snapshot_task.cancel()
+            try:
+                await self._snapshot_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            fut = self._snapshot_write_fut
+            if fut is not None and not fut.done():
+                try:
+                    await fut
+                except Exception:
+                    pass
         for task in list(self._sync_tasks):
             task.cancel()
         if self.snapshot_path:

@@ -114,7 +114,7 @@ class StoreValue:
         for epoch in [e for e in self.grants if e < horizon]:
             del self.grants[epoch]
 
-    def certificate_timestamp(self) -> Optional[int]:
+    def certificate_timestamp(self, replica_set: Optional[set] = None) -> Optional[int]:
         """Timestamp certified for this key by the current certificate
         (ref: ``getCurrentTimestampFromCurrentCertificate``, SVOC.java:175-198).
 
@@ -124,14 +124,27 @@ class StoreValue:
         signed non-OK (refused/wrong-shard) or minority grants from Byzantine
         in-set peers — those must not be able to poison this accessor (a
         raise here would brick the key for every later Write2/resync).
+
+        When ``replica_set`` is given (the normal server path), only grants
+        from servers inside the key's replica set contribute, one vote per
+        server — the same in-set restriction ``_coalesce_grants`` enforces.
+        Without it, out-of-set signers colluding with a Byzantine client
+        could out-vote the legitimate 2f+1 in-set quorum and flip the stored
+        timestamp (poisoning the staleness check in ``process_write2``).
         """
         if self.current_certificate is None:
             return None
         counts: Dict[int, int] = {}
+        voted: set = set()
         for mg in self.current_certificate.grants.values():
             grant = mg.grants.get(self.key)
             if grant is None or grant.status != Status.OK:
                 continue
+            if replica_set is not None and (
+                mg.server_id not in replica_set or mg.server_id in voted
+            ):
+                continue
+            voted.add(mg.server_id)
             counts[grant.timestamp] = counts.get(grant.timestamp, 0) + 1
         if not counts:
             return None
@@ -176,6 +189,10 @@ class DataStore:
 
     def owns(self, key: str) -> bool:
         return self.config.owns_key(self.server_id, key)
+
+    def _cert_ts(self, sv: StoreValue) -> Optional[int]:
+        """``certificate_timestamp`` restricted to the key's replica set."""
+        return sv.certificate_timestamp(set(self.config.replica_set_for_key(sv.key)))
 
     def stats(self) -> Dict[str, int]:
         """Operator-facing counters (served by the admin HTTP shell)."""
@@ -334,7 +351,7 @@ class DataStore:
                     FailType.BAD_CERTIFICATE, f"transaction hash mismatch for {op.key}"
                 )
             sv = self._get_or_create(op.key)
-            current_ts = sv.certificate_timestamp()
+            current_ts = self._cert_ts(sv)
             if current_ts is not None and current_ts > ts:
                 # Stale write2: answer with current state instead
                 # (ref: InMemoryDataStore.java:594-598).
@@ -411,14 +428,14 @@ class DataStore:
         """Apply one state-transfer entry through the full Write2 validation
         (quorum, hash, staleness).  Returns True if state advanced."""
         sv_before = self._get(entry.key)
-        ts_before = sv_before.certificate_timestamp() if sv_before else None
+        ts_before = self._cert_ts(sv_before) if sv_before else None
         response = self.process_write2(
             Write2ToServer(entry.certificate, entry.transaction)
         )
         if not isinstance(response, Write2AnsFromServer):
             return False
         sv_after = self._get(entry.key)
-        ts_after = sv_after.certificate_timestamp() if sv_after else None
+        ts_after = self._cert_ts(sv_after) if sv_after else None
         return ts_after is not None and ts_after != ts_before
 
 

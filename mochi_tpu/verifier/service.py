@@ -1,0 +1,192 @@
+"""Verifier RPC service: one process owns the TPU, the cluster shares it.
+
+The north star (BASELINE.json) draws the replica ↔ accelerator boundary as a
+sidecar RPC: replica processes buffer signature checks and ship them to the
+single JAX process that owns the chip, which returns a validity bitmap.  An
+in-process ``VirtualCluster`` doesn't need this — its replicas share the
+interpreter with the device owner — but a real ``scripts/start_cluster.sh``
+cluster is N separate OS processes, and a TPU has exactly one owner process:
+without this service, N-1 replicas are stuck on the CPU path
+(VERDICT.md round-1 missing #3).
+
+Server: :class:`VerifierService` — an ``RpcServer`` (the same length-prefixed
+mcode transport the replicas speak, ``net/transport.py``) in front of a
+:class:`~mochi_tpu.verifier.spi.BatchingVerifier` over the JAX device.
+Requests from many replicas coalesce in the batcher, so the *cluster-wide*
+signature stream forms device-sized batches even when each replica's own
+traffic is thin — exactly the aggregation the reference's per-JVM
+BouncyCastle model can never do.
+
+Client: :class:`RemoteVerifier` — a ``SignatureVerifier`` that ships batches
+to the service and falls back to local CPU verification if the service is
+unreachable (availability degrades to the reference-analog path; safety —
+never skip a check — is preserved).
+
+Run:  ``python -m mochi_tpu.verifier.service --port 18200``
+Wire: ``python -m mochi_tpu.server ... --verifier remote:127.0.0.1:18200``
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import time
+from typing import List, Optional, Sequence
+
+from ..cluster.config import ServerInfo
+from ..net.transport import RpcServer, _Connection, new_msg_id
+from ..protocol import Envelope, VerifyBitmapFromServer, VerifyRequestToServer
+from .spi import BatchingVerifier, CpuVerifier, SignatureVerifier, VerifyItem
+
+LOG = logging.getLogger(__name__)
+
+SERVICE_ID = "verifier-service"
+
+
+class VerifierService:
+    """TPU-owning verification service shared by all replica processes."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 18200,
+        verifier: Optional[SignatureVerifier] = None,
+        max_items_per_request: int = 65536,
+    ):
+        if verifier is None:
+            from .tpu import TpuBatchVerifier
+
+            verifier = TpuBatchVerifier()
+        self.verifier = verifier
+        self.max_items_per_request = max_items_per_request
+        self.rpc = RpcServer(host, port, self._handle)
+        self.requests = 0
+        self.items = 0
+
+    async def start(self) -> None:
+        await self.rpc.start()
+
+    async def close(self) -> None:
+        await self.rpc.close()
+        await self.verifier.close()
+
+    @property
+    def bound_port(self) -> int:
+        return self.rpc.bound_port
+
+    async def _handle(self, env: Envelope) -> Optional[Envelope]:
+        if not isinstance(env.payload, VerifyRequestToServer):
+            return None  # not our protocol; drop (client times out)
+        items = env.payload.items
+        if len(items) > self.max_items_per_request:
+            return None
+        bitmap = await self.verifier.verify_batch(
+            [VerifyItem(pk, msg, sig) for pk, msg, sig in items]
+        )
+        self.requests += 1
+        self.items += len(items)
+        return Envelope(
+            VerifyBitmapFromServer(tuple(bitmap)),
+            msg_id=new_msg_id(),
+            sender_id=SERVICE_ID,
+            reply_to=env.msg_id,
+        )
+
+
+class RemoteVerifier(SignatureVerifier):
+    """Ship verification batches to a :class:`VerifierService`.
+
+    The replica keeps its own micro-batching upstream (``BatchingVerifier``
+    can wrap this), but even bare it benefits from the service-side batcher
+    coalescing traffic across the whole cluster.  On transport failure the
+    batch is re-verified locally (CPU) — never skipped.
+    """
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        timeout_s: float = 30.0,
+        fallback: Optional[SignatureVerifier] = None,
+    ):
+        self._conn = _Connection(ServerInfo("verifier", host, port))
+        self.timeout_s = timeout_s
+        self.fallback = fallback if fallback is not None else CpuVerifier()
+        self.remote_batches = 0
+        self.fallback_batches = 0
+
+    async def verify_batch(self, items: Sequence[VerifyItem]) -> List[bool]:
+        if not items:
+            return []
+        req = Envelope(
+            VerifyRequestToServer(
+                tuple((it.public_key, it.message, it.signature) for it in items)
+            ),
+            msg_id=new_msg_id(),
+            sender_id="verifier-client",
+        )
+        try:
+            resp = await self._conn.send_and_receive(req, self.timeout_s)
+            payload = resp.payload
+            if (
+                not isinstance(payload, VerifyBitmapFromServer)
+                or len(payload.bitmap) != len(items)
+            ):
+                raise ValueError("malformed verifier response")
+            self.remote_batches += 1
+            return [bool(b) for b in payload.bitmap]
+        except Exception:
+            LOG.exception("remote verify failed; falling back to CPU")
+            self.fallback_batches += 1
+            return await self.fallback.verify_batch(items)
+
+    async def close(self) -> None:
+        await self._conn.close()
+        await self.fallback.close()
+
+
+async def amain(args) -> None:
+    verifier: Optional[SignatureVerifier] = None
+    if args.backend == "cpu":
+        verifier = CpuVerifier()
+    elif args.backend == "tpu":
+        from .tpu import TpuBatchVerifier
+
+        t0 = time.time()
+        verifier = TpuBatchVerifier(
+            warmup_buckets=tuple(int(b) for b in args.warmup.split(",") if b)
+        )
+        LOG.info("device warmup took %.1fs", time.time() - t0)
+    service = VerifierService(host=args.host, port=args.port, verifier=verifier)
+    await service.start()
+    print(f"READY {SERVICE_ID} {service.bound_port}", flush=True)
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await service.close()
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=18200)
+    parser.add_argument("--backend", choices=("tpu", "cpu"), default="tpu")
+    parser.add_argument(
+        "--warmup",
+        default="16,256",
+        help="comma-separated bucket sizes to pre-compile at boot",
+    )
+    parser.add_argument("--log-level", default="INFO")
+    args = parser.parse_args(argv)
+    logging.basicConfig(
+        level=args.log_level, format="%(asctime)s %(name)s %(levelname)s %(message)s"
+    )
+    try:
+        asyncio.run(amain(args))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

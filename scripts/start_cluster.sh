@@ -20,13 +20,31 @@ fi
 
 mkdir -p "$OUT/log"
 PIDS=()
+
+# MOCHI_VERIFIER=remote -> boot ONE TPU-owning verifier service and point
+# every replica at it (a chip has a single owner process; this is the only
+# way a multi-process cluster gets TPU-backed verification).  Other values
+# (cpu | tpu | remote:<host>:<port>) pass through per replica.
+VERIFIER="${MOCHI_VERIFIER:-cpu}"
+if [ "$VERIFIER" = "remote" ]; then
+  VPORT=$((BASE_PORT + 2000))
+  python -m mochi_tpu.verifier.service --port "$VPORT" \
+    >"$OUT/log/verifier.log" 2>&1 &
+  PIDS+=($!)
+  for _ in $(seq 1 120); do
+    grep -q READY "$OUT/log/verifier.log" 2>/dev/null && break
+    sleep 1
+  done
+  VERIFIER="remote:127.0.0.1:$VPORT"
+fi
+
 for i in $(seq 0 $((N - 1))); do
   python -m mochi_tpu.server \
     --config "$OUT/cluster_config.json" \
     --server-id "server-$i" \
     --seed-file "$OUT/server-$i.seed" \
     --admin-port $((BASE_PORT + 1000 + i)) \
-    --verifier "${MOCHI_VERIFIER:-cpu}" \
+    --verifier "$VERIFIER" \
     >"$OUT/log/server-$i.log" 2>&1 &
   PIDS+=($!)
 done

@@ -39,6 +39,31 @@ def test_certificate_timestamp_survives_byzantine_extras():
     assert sv2.certificate_timestamp() is None
 
 
+def test_certificate_timestamp_ignores_out_of_set_signers():
+    """Out-of-set signers must not out-vote the in-set quorum (ADVICE r1 #2).
+
+    With replica_set given, only in-set servers vote — one vote each — so a
+    Byzantine client embedding many validly-signed out-of-set grants at a
+    bogus timestamp cannot flip the stored timestamp and poison the
+    staleness check in ``process_write2``.
+    """
+    ok = lambda sid, ts: MultiGrant(
+        {"k": Grant("k", ts, 1, b"h", Status.OK)}, "c", sid
+    )
+    in_set = {"s1", "s2", "s3", "s4"}
+    grants = {f"s{i}": ok(f"s{i}", 500) for i in range(1, 5)}
+    # five out-of-set colluders all vote timestamp 9000 (more raw votes)
+    grants.update({f"x{i}": ok(f"x{i}", 9000) for i in range(5)})
+    # plus a duplicated in-set server id under a different dict key
+    grants["dup"] = ok("s1", 9000)
+    sv = StoreValue("k")
+    sv.current_certificate = WriteCertificate(grants)
+    assert sv.certificate_timestamp(in_set) == 500
+    # unrestricted view would have been poisoned — documents why the
+    # server path always passes the replica set
+    assert sv.certificate_timestamp() == 9000
+
+
 def test_resync_pages_through_large_store():
     async def main():
         async with VirtualCluster(4, rf=4) as vc:

@@ -1,0 +1,94 @@
+"""Verifier RPC service: the shared-TPU sidecar boundary (VERDICT r1 #5).
+
+A real multi-process cluster has one TPU owner; these tests prove the
+service + RemoteVerifier pair end to end — in-process for speed (the
+transport is the same real asyncio TCP the cluster uses), and via a full
+``VirtualCluster`` whose replicas all route certificate checks through one
+shared service.
+"""
+
+import asyncio
+
+from mochi_tpu.client import TransactionBuilder
+from mochi_tpu.crypto.keys import generate_keypair
+from mochi_tpu.testing import VirtualCluster
+from mochi_tpu.verifier.service import RemoteVerifier, VerifierService
+from mochi_tpu.verifier.spi import CpuVerifier, VerifyItem
+
+
+def run(coro):
+    asyncio.run(asyncio.wait_for(coro, timeout=120))
+
+
+def make_items(n, forge=()):
+    kp = generate_keypair()
+    items = []
+    for i in range(n):
+        msg = b"svc message %d" % i
+        sig = kp.sign(msg)
+        if i in forge:
+            sig = bytes([sig[0] ^ 1]) + sig[1:]
+        items.append(VerifyItem(kp.public_key, msg, sig))
+    return items
+
+
+def test_remote_verify_mixed_batch():
+    async def main():
+        service = VerifierService(port=0, verifier=CpuVerifier())
+        await service.start()
+        rv = RemoteVerifier("127.0.0.1", service.bound_port)
+        try:
+            bitmap = await rv.verify_batch(make_items(8, forge={2, 5}))
+            assert bitmap == [True, True, False, True, True, False, True, True]
+            assert rv.remote_batches == 1 and rv.fallback_batches == 0
+            assert service.requests == 1 and service.items == 8
+        finally:
+            await rv.close()
+            await service.close()
+
+    run(main())
+
+
+def test_remote_verifier_falls_back_when_service_down():
+    async def main():
+        # nothing listening on this port
+        rv = RemoteVerifier("127.0.0.1", 1, timeout_s=2.0)
+        try:
+            bitmap = await rv.verify_batch(make_items(4, forge={1}))
+            # fallback still verifies (never skips): forged item rejected
+            assert bitmap == [True, False, True, True]
+            assert rv.fallback_batches == 1
+        finally:
+            await rv.close()
+
+    run(main())
+
+
+def test_cluster_routes_cert_checks_through_shared_service():
+    async def main():
+        service = VerifierService(port=0, verifier=CpuVerifier())
+        await service.start()
+        port = service.bound_port
+        try:
+            async with VirtualCluster(
+                4, rf=4,
+                verifier_factory=lambda: RemoteVerifier("127.0.0.1", port),
+            ) as vc:
+                client = vc.client()
+                await client.execute_write_transaction(
+                    TransactionBuilder().write("svc-key", b"v").build()
+                )
+                res = await client.execute_read_transaction(
+                    TransactionBuilder().read("svc-key").build()
+                )
+                assert res.operations[0].value == b"v"
+                # every replica's envelope/cert checks went through the one
+                # service process-equivalent
+                assert service.requests >= 4
+                for r in vc.replicas:
+                    assert isinstance(r.verifier, RemoteVerifier)
+                    assert r.verifier.fallback_batches == 0
+        finally:
+            await service.close()
+
+    run(main())
