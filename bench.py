@@ -32,6 +32,7 @@ import json
 import os
 import subprocess
 import sys
+import threading
 import time
 
 _REPO = os.path.dirname(os.path.abspath(__file__))
@@ -112,7 +113,10 @@ def _measure() -> dict:
     impls["xla"] = xla
 
     # ---- Pallas kernel --------------------------------------------------
-    if dev.platform == "tpu":
+    # Shelved after measurement: Mosaic compile exceeded 15 min vs XLA's
+    # 40 s for a slower-or-equal program (pallas_verify.py docstring has the
+    # full verdict).  Re-enable explicitly to re-test on newer toolchains.
+    if dev.platform == "tpu" and os.environ.get("MOCHI_BENCH_PALLAS") == "1":
         try:
             from mochi_tpu.crypto.pallas_verify import verify_prepared_pallas
 
@@ -141,6 +145,12 @@ def _measure() -> dict:
             impls["pallas"] = pal
         except Exception as exc:  # prove-or-kill: record, don't crash
             impls["pallas"] = {"error": f"{type(exc).__name__}: {exc}"[:500]}
+    elif dev.platform == "tpu":
+        impls["pallas"] = {
+            "skipped": "shelved after measurement: Mosaic compile >15min at "
+            "block 128/256 vs 40s XLA compile; XLA path already uses the "
+            "limbs-on-lanes layout (pallas_verify.py docstring)"
+        }
 
     best_impl, (best_batch, best_rate) = max(
         ((name, i["best"]) for name, i in impls.items() if "best" in i),
@@ -173,7 +183,7 @@ def _measure() -> dict:
         "impls": impls,
         "cpu_openssl_sigs_per_sec": round(cpu_rate, 1),
         "cpu_allcores_sigs_per_sec": round(cpu_allcores, 1),
-        "vs_cpu_allcores": round(best_rate / cpu_allcores, 3),
+        "vs_cpu_allcores": round(best_rate / cpu_allcores, 3) if cpu_allcores else None,
         "cpu_cores": ncores,
         "ops_per_sig_xla_cost_analysis": round(flops_per_sig or 0.0),
         "mfu_vs_vpu_peak": round(mfu, 4) if mfu is not None else None,
@@ -212,27 +222,65 @@ def _child() -> None:
         jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+    # Liveness marker: backend init is where a wedged TPU plugin hangs
+    # (round-1 failure mode).  The parent gives init a short deadline and
+    # only grants the long compile+measure budget once this line appears.
+    n = len(jax.devices())
+    print(f"BENCH_ALIVE devices={n}", flush=True)
     print("BENCH_JSON " + json.dumps(_measure()), flush=True)
 
 
-def _run_child(force_cpu: bool, timeout_s: float):
+def _run_child(force_cpu: bool, timeout_s: float, alive_timeout_s: float = 120.0):
     env = dict(os.environ)
     if force_cpu:
         env.update({"MOCHI_BENCH_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu",
                     "PALLAS_AXON_POOL_IPS": ""})
+    proc = subprocess.Popen(
+        [sys.executable, "-u", os.path.abspath(__file__), "--child"],
+        env=env, cwd=_REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, errors="replace",
+    )
+    lines: list = []
+    done = threading.Event()
+
+    def pump():
+        for line in proc.stdout:
+            lines.append(line)
+        done.set()
+
+    t = threading.Thread(target=pump, daemon=True)
+    t.start()
+
+    def scan(prefix):
+        return next((l for l in list(lines) if l.startswith(prefix)), None)
+
+    alive = False
+    deadline = time.monotonic() + alive_timeout_s
     try:
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--child"],
-            env=env, cwd=_REPO, stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT, timeout=timeout_s,
-        )
-    except subprocess.TimeoutExpired:
-        return None, "timeout"
-    out = proc.stdout.decode(errors="replace")
-    for line in reversed(out.splitlines()):
-        if line.startswith("BENCH_JSON "):
-            return json.loads(line[len("BENCH_JSON "):]), None
-    return None, f"rc={proc.returncode} tail={out[-1500:]}"
+        while True:
+            if scan("BENCH_JSON ") is not None:
+                line = scan("BENCH_JSON ")
+                proc.wait()
+                return json.loads(line[len("BENCH_JSON "):]), None
+            if not alive and scan("BENCH_ALIVE") is not None:
+                alive = True
+                deadline = time.monotonic() + timeout_s
+            if done.is_set() and proc.poll() is not None:
+                break
+            if time.monotonic() > deadline:
+                proc.kill()
+                proc.wait()
+                return None, ("backend-init watchdog expired" if not alive
+                              else "measurement timeout")
+            time.sleep(0.25)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+    final = scan("BENCH_JSON ")  # may have landed between last scan and exit
+    if final is not None:
+        return json.loads(final[len("BENCH_JSON "):]), None
+    return None, f"rc={proc.returncode} tail={''.join(lines)[-1500:]}"
 
 
 def main() -> None:

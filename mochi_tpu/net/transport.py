@@ -253,20 +253,23 @@ def new_msg_id() -> str:
 async def fan_out(
     pool: RpcClientPool,
     targets: Iterable[Tuple[str, ServerInfo]],
-    make_envelope: Callable[[str], Envelope],
+    make_envelope: Callable[..., Envelope],
     timeout_s: Optional[float] = None,
 ) -> Dict[str, "Envelope | Exception"]:
     """Send one envelope per target concurrently; gather results or exceptions
     per server id (ref: ``Utils.sendMessageToServers`` + ``busyWaitForFutures``,
     ``Utils.java:65-123`` — awaiting real futures instead of 5 ms poll loops).
+
+    ``make_envelope`` is called as ``(msg_id, server_id)`` so callers can
+    authenticate per target (session MACs).
     """
     targets = list(targets)
 
-    async def one(info: ServerInfo) -> Envelope:
-        return await pool.send_and_receive(info, make_envelope(new_msg_id()), timeout_s)
+    async def one(sid: str, info: ServerInfo) -> Envelope:
+        return await pool.send_and_receive(info, make_envelope(new_msg_id(), sid), timeout_s)
 
     results = await asyncio.gather(
-        *(one(info) for _, info in targets), return_exceptions=True
+        *(one(sid, info) for sid, info in targets), return_exceptions=True
     )
     out: Dict[str, Envelope | Exception] = {}
     for (sid, _), res in zip(targets, results):

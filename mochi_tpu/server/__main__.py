@@ -57,6 +57,13 @@ async def amain(args) -> None:
         if not host or not port.isdigit():
             raise SystemExit(f"--verifier remote:<host>:<port> (got {args.verifier!r})")
         verifier = RemoteVerifier(host, int(port))
+    elif args.verifier != "cpu":
+        # No silent fallback: a typo'd --verifier must not quietly run the
+        # inline CPU path (the misconfiguration argparse choices= used to
+        # reject before remote:<host>:<port> made the value open-ended).
+        raise SystemExit(
+            f"unknown --verifier {args.verifier!r}: use cpu | tpu | remote:<host>:<port>"
+        )
     snapshot_path = None
     if args.data_dir:
         snapshot_path = str(Path(args.data_dir) / f"{args.server_id}.snapshot")

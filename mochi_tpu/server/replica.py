@@ -26,6 +26,7 @@ from dataclasses import replace
 from typing import Dict, Iterable, Optional
 
 from ..cluster.config import ClusterConfig
+from ..crypto import session as session_crypto
 from ..crypto.keys import KeyPair
 from ..net.transport import RpcClientPool, RpcServer
 from ..protocol import (
@@ -37,6 +38,8 @@ from ..protocol import (
     ReadFromServer,
     ReadToServer,
     RequestFailedFromServer,
+    SessionAckFromServer,
+    SessionInitToServer,
     SyncAckFromServer,
     SyncEntriesFromServer,
     SyncRequestToServer,
@@ -87,6 +90,10 @@ class MochiReplica:
         self.snapshot_interval_s = snapshot_interval_s
         self._snapshot_task: Optional[asyncio.Task] = None
         self._snapshot_write_fut: Optional[asyncio.Future] = None
+        # sender_id -> session MAC key (crypto/session.py): envelope auth at
+        # HMAC cost; Ed25519 reserved for MultiGrants.  Lost on restart —
+        # clients re-handshake when their MAC'd request bounces.
+        self._sessions: Dict[str, bytes] = {}
 
     # ----------------------------------------------------------------- boot
 
@@ -161,6 +168,11 @@ class MochiReplica:
         return key
 
     async def _authenticate(self, env: Envelope) -> bool:
+        if env.mac is not None:
+            session_key = self._sessions.get(env.sender_id)
+            return session_key is not None and session_crypto.mac_ok(
+                session_key, env.signing_bytes(), env.mac
+            )
         key = self._sender_key(env.sender_id)
         if key is None:
             # Unknown sender: only acceptable in open (non-auth-required) mode.
@@ -175,7 +187,7 @@ class MochiReplica:
             )
         return ok
 
-    def _respond(self, env: Envelope, payload) -> Envelope:
+    def _respond(self, env: Envelope, payload, force_sign: bool = False) -> Envelope:
         response = Envelope(
             payload=payload,
             msg_id=uuid.uuid4().hex,
@@ -183,6 +195,18 @@ class MochiReplica:
             reply_to=env.msg_id,
             timestamp_ms=int(time.time() * 1000),
         )
+        # Respond IN KIND: MAC only when the request itself was MAC'd.  A
+        # half-established session (our ack was lost; the client stayed on
+        # signatures) must not make us MAC responses the client cannot
+        # check — it would drop them as unauthenticated and this replica
+        # would silently stop counting toward quorums.
+        session_key = None
+        if not force_sign and env.mac is not None:
+            session_key = self._sessions.get(env.sender_id)
+        if session_key is not None:
+            return response.with_mac(
+                session_crypto.mac(session_key, response.signing_bytes())
+            )
         return response.with_signature(self.keypair.sign(response.signing_bytes()))
 
     async def handle_envelope(self, env: Envelope) -> Optional[Envelope]:
@@ -193,6 +217,28 @@ class MochiReplica:
                 env, RequestFailedFromServer(FailType.BAD_SIGNATURE, "envelope signature invalid")
             )
         payload = env.payload
+        if isinstance(payload, SessionInitToServer):
+            # The ack must be Ed25519-SIGNED (not MAC'd): its signature is
+            # what proves to the initiator that no MITM swapped X25519 keys.
+            # A MAC'd handshake request is meaningless — require signature
+            # semantics (enforced above: mac path only passes for an already
+            # established session, which a fresh handshake won't have).
+            hs = session_crypto.new_handshake()
+            ack = self._respond(
+                env,
+                SessionAckFromServer(hs.public_bytes, hs.nonce),
+                force_sign=True,
+            )
+            self._sessions[env.sender_id] = session_crypto.derive_key(
+                hs,
+                payload.x25519_public,
+                payload.nonce,
+                initiator_id=env.sender_id,
+                responder_id=self.server_id,
+                initiated=False,
+            )
+            self.metrics.mark("replica.sessions-established")
+            return ack
         if isinstance(payload, HelloToServer):
             return self._respond(env, HelloFromServer(f"{payload.message} back"))
         if isinstance(payload, ReadToServer):

@@ -36,8 +36,20 @@ from typing import List, Optional, Sequence
 
 from ..cluster.config import ServerInfo
 from ..net.transport import RpcServer, _Connection, new_msg_id
-from ..protocol import Envelope, VerifyBitmapFromServer, VerifyRequestToServer
-from .spi import BatchingVerifier, CpuVerifier, SignatureVerifier, VerifyItem
+from ..protocol import (
+    Envelope,
+    FailType,
+    RequestFailedFromServer,
+    VerifyBitmapFromServer,
+    VerifyRequestToServer,
+)
+from .spi import (
+    BatchingVerifier,
+    CachingVerifier,
+    CpuVerifier,
+    SignatureVerifier,
+    VerifyItem,
+)
 
 LOG = logging.getLogger(__name__)
 
@@ -53,11 +65,17 @@ class VerifierService:
         port: int = 18200,
         verifier: Optional[SignatureVerifier] = None,
         max_items_per_request: int = 65536,
+        cache: bool = True,
     ):
         if verifier is None:
             from .tpu import TpuBatchVerifier
 
             verifier = TpuBatchVerifier()
+        if cache:
+            # Every replica of a set re-checks the same certificate grants;
+            # the service-level memo collapses those rf duplicates into one
+            # device verification (CachingVerifier docstring).
+            verifier = CachingVerifier(verifier)
         self.verifier = verifier
         self.max_items_per_request = max_items_per_request
         self.rpc = RpcServer(host, port, self._handle)
@@ -76,11 +94,24 @@ class VerifierService:
         return self.rpc.bound_port
 
     async def _handle(self, env: Envelope) -> Optional[Envelope]:
+        def fail(ft: FailType, detail: str) -> Envelope:
+            # Fail FAST with a typed error — a silent drop would park the
+            # requesting replica for its full RPC timeout.
+            return Envelope(
+                RequestFailedFromServer(ft, detail),
+                msg_id=new_msg_id(),
+                sender_id=SERVICE_ID,
+                reply_to=env.msg_id,
+            )
+
         if not isinstance(env.payload, VerifyRequestToServer):
-            return None  # not our protocol; drop (client times out)
+            return fail(FailType.BAD_REQUEST, "expected VerifyRequestToServer")
         items = env.payload.items
         if len(items) > self.max_items_per_request:
-            return None
+            return fail(
+                FailType.BAD_REQUEST,
+                f"{len(items)} items > limit {self.max_items_per_request}",
+            )
         bitmap = await self.verifier.verify_batch(
             [VerifyItem(pk, msg, sig) for pk, msg, sig in items]
         )
@@ -103,6 +134,10 @@ class RemoteVerifier(SignatureVerifier):
     batch is re-verified locally (CPU) — never skipped.
     """
 
+    # client-side request cap, kept under the service default so one request
+    # can never trip the service's oversize rejection
+    MAX_REQUEST_ITEMS = 16384
+
     def __init__(
         self,
         host: str,
@@ -119,6 +154,11 @@ class RemoteVerifier(SignatureVerifier):
     async def verify_batch(self, items: Sequence[VerifyItem]) -> List[bool]:
         if not items:
             return []
+        if len(items) > self.MAX_REQUEST_ITEMS:
+            out: List[bool] = []
+            for i in range(0, len(items), self.MAX_REQUEST_ITEMS):
+                out.extend(await self.verify_batch(items[i : i + self.MAX_REQUEST_ITEMS]))
+            return out
         req = Envelope(
             VerifyRequestToServer(
                 tuple((it.public_key, it.message, it.signature) for it in items)

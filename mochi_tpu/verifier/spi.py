@@ -56,6 +56,49 @@ class CpuVerifier(SignatureVerifier):
         ]
 
 
+class CachingVerifier(SignatureVerifier):
+    """LRU memo over any verifier — verification is a pure function of
+    (public key, message, signature), so caching is sound.
+
+    Where it pays: the shared verifier service (``verifier/service.py``)
+    sees the SAME MultiGrant from every replica of the set within
+    milliseconds (each replica independently checks the certificate, as BFT
+    requires) — one device/CPU verification serves all rf of them.  Negative
+    results are cached too (a forged grant replayed across replicas costs
+    one check, not rf).
+    """
+
+    def __init__(self, inner: SignatureVerifier, max_entries: int = 1 << 16):
+        self.inner = inner
+        self.max_entries = max_entries
+        self._cache: "dict[Tuple[bytes, bytes, bytes], bool]" = {}
+        self.hits = 0
+        self.misses = 0
+
+    async def verify_batch(self, items: Sequence[VerifyItem]) -> List[bool]:
+        out: List[Optional[bool]] = []
+        missing: List[Tuple[int, VerifyItem]] = []
+        for i, it in enumerate(items):
+            cached = self._cache.get((it.public_key, it.message, it.signature))
+            out.append(cached)
+            if cached is None:
+                missing.append((i, it))
+        self.hits += len(items) - len(missing)
+        self.misses += len(missing)
+        if missing:
+            bitmap = await self.inner.verify_batch([it for _, it in missing])
+            for (i, it), ok in zip(missing, bitmap):
+                out[i] = bool(ok)
+                if len(self._cache) >= self.max_entries:
+                    # drop the oldest insertion (dict preserves order)
+                    self._cache.pop(next(iter(self._cache)))
+                self._cache[(it.public_key, it.message, it.signature)] = bool(ok)
+        return [bool(b) for b in out]
+
+    async def close(self) -> None:
+        await self.inner.close()
+
+
 BatchBackend = Callable[[Sequence[VerifyItem]], Sequence[bool]]
 
 

@@ -178,3 +178,135 @@ def test_response_impersonation_dropped_by_client():
             assert not client._authentic("server-0", env)
 
     run(main())
+
+
+def test_certificate_replay_against_different_transaction():
+    """VERDICT r1 task 8(b): a committed certificate replayed with a
+    DIFFERENT transaction must fail the per-grant transaction-hash check
+    (the reference's check at ``InMemoryDataStore.java:580,591``)."""
+
+    async def main():
+        async with VirtualCluster(4, rf=4) as vc:
+            client = vc.client()
+            txn1 = TransactionBuilder().write("rk", b"legit").build()
+            grants = await write1_via_wire(vc, client, txn1)
+            wc = WriteCertificate(grants)
+            for sid, info in sorted(vc.config.servers.items()):
+                env = client._envelope(Write2ToServer(wc, txn1), f"w2-legit-{sid}")
+                resp = await client.pool.send_and_receive(info, env)
+                assert isinstance(resp.payload, Write2AnsFromServer)
+
+            # Replay the SAME (validly signed) certificate with another txn.
+            txn2 = TransactionBuilder().write("rk", b"evil").build()
+            env = client._envelope(Write2ToServer(wc, txn2), "w2-replay")
+            resp = await client.pool.send_and_receive(vc.config.servers["server-0"], env)
+            assert isinstance(resp.payload, RequestFailedFromServer)
+            assert resp.payload.fail_type == FailType.BAD_CERTIFICATE
+            # and the value is untouched
+            r = await client.execute_read_transaction(
+                TransactionBuilder().read("rk").build()
+            )
+            assert r.operations[0].value == b"legit"
+
+    run(main())
+
+
+def test_equivocating_server_cannot_flip_a_commit():
+    """VERDICT r1 task 8(a): one in-set server (<= f) signs a CONFLICTING
+    grant — same key, same timestamp, different transaction — for a second
+    client.  The equivocation is validly signed, but a single equivocator
+    can never assemble 2f+1 grants for the conflicting transaction, so the
+    honest commit stands."""
+
+    async def main():
+        async with VirtualCluster(4, rf=4) as vc:
+            client = vc.client()
+            txn1 = TransactionBuilder().write("eq", b"honest").build()
+            grants = await write1_via_wire(vc, client, txn1, seed=123)
+            ts = next(iter(grants["server-1"].grants.values())).timestamp
+
+            # commit txn1 with the full honest certificate on every replica
+            for sid, info in sorted(vc.config.servers.items()):
+                env = client._envelope(
+                    Write2ToServer(WriteCertificate(grants), txn1), f"w2-h-{sid}"
+                )
+                resp = await client.pool.send_and_receive(info, env)
+                assert isinstance(resp.payload, Write2AnsFromServer)
+
+            # server-1 equivocates: signs a grant for txn2 at the SAME ts
+            # (we have its real key — VirtualCluster exposes keypairs)
+            txn2 = TransactionBuilder().write("eq", b"evil").build()
+            from mochi_tpu.protocol import Grant, Status
+
+            evil_grant = Grant("eq", ts, vc.config.configstamp, transaction_hash(txn2), Status.OK)
+            evil_mg = MultiGrant({"eq": evil_grant}, client.client_id, "server-1")
+            evil_mg = evil_mg.with_signature(
+                vc.keypairs["server-1"].sign(evil_mg.signing_bytes())
+            )
+            thin_wc = WriteCertificate({"server-1": evil_mg})
+            env = client._envelope(Write2ToServer(thin_wc, txn2), "w2-eq")
+            resp = await client.pool.send_and_receive(vc.config.servers["server-0"], env)
+            # validly signed but 1 < quorum 3 → rejected
+            assert isinstance(resp.payload, RequestFailedFromServer)
+            assert resp.payload.fail_type == FailType.BAD_CERTIFICATE
+            r = await client.execute_read_transaction(
+                TransactionBuilder().read("eq").build()
+            )
+            assert r.operations[0].value == b"honest"
+
+    run(main())
+
+
+def test_restart_storm_with_resync_under_load():
+    """VERDICT r1 task 8(c): f+1 simultaneous restarts while writers keep
+    running; restarted replicas resync and the cluster converges with no
+    inconsistency."""
+
+    async def main():
+        async with VirtualCluster(5, rf=4) as vc:
+            client = vc.client()
+            committed = {}
+
+            async def writer(tag: str, n: int):
+                c = vc.client()
+                for i in range(n):
+                    key = f"storm-{tag}-{i}"
+                    val = b"v-" + tag.encode() + b"-%d" % i
+                    try:
+                        await c.execute_write_transaction(
+                            TransactionBuilder().write(key, val).build()
+                        )
+                        committed[key] = val
+                    except Exception:
+                        pass  # transient quorum loss during the storm is legal
+                await c.close()
+
+            async def storm():
+                await asyncio.sleep(0.05)
+                # f+1 = 2 simultaneous restarts, resync on boot
+                await asyncio.gather(
+                    vc.restart_replica("server-1", resync=True),
+                    vc.restart_replica("server-2", resync=True),
+                )
+
+            await asyncio.gather(writer("a", 15), writer("b", 15), storm())
+            assert committed, "no write survived the storm"
+
+            # everything acknowledged must read back consistently
+            for key, val in committed.items():
+                r = await client.execute_read_transaction(
+                    TransactionBuilder().read(key).build()
+                )
+                assert r.operations[0].value == val, key
+
+            # restarted replicas hold resynced state for acknowledged keys
+            fresh = vc.replica("server-1")
+            owned = [k for k in committed if fresh.store.owns(k)]
+            have = sum(
+                1
+                for k in owned
+                if (sv := fresh.store._get(k)) is not None and sv.current_certificate
+            )
+            assert owned and have >= len(owned) // 2, (have, len(owned))
+
+    run(main())
