@@ -25,7 +25,12 @@ import uuid
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
-from ..cluster.config import ClusterConfig, ServerInfo
+from ..cluster.config import (
+    CONFIG_ARCHIVE_PREFIX,
+    CONFIG_CLUSTER_KEY,
+    ClusterConfig,
+    ServerInfo,
+)
 from ..crypto import session as session_crypto
 from ..crypto.keys import KeyPair, generate_keypair, verify as cpu_verify
 from ..net.transport import RpcClientPool, fan_out, new_msg_id
@@ -92,6 +97,20 @@ class MochiDBClient:
                 seen[info.server_id] = info
         return sorted(seen.items())
 
+    @staticmethod
+    def _is_admin_txn(transaction: Transaction) -> bool:
+        return any(
+            op.key.startswith(CONFIG_CLUSTER_KEY) for op in transaction.operations
+        )
+
+    @classmethod
+    def _needs_signature(cls, payload) -> bool:
+        """Admin (reconfiguration) requests must ride SIGNED envelopes: the
+        replica's admin check proves key ownership via the signature, which
+        an open-mode session MAC cannot (replica._admin_sig_ok)."""
+        txn = getattr(payload, "transaction", None)
+        return txn is not None and cls._is_admin_txn(txn)
+
     def _envelope(self, payload, msg_id: str, sid: Optional[str] = None) -> Envelope:
         env = Envelope(
             payload=payload,
@@ -100,7 +119,7 @@ class MochiDBClient:
             timestamp_ms=int(time.time() * 1000),
         )
         session_key = self._sessions.get(sid) if sid is not None else None
-        if session_key is not None:
+        if session_key is not None and not self._needs_signature(payload):
             return env.with_mac(session_crypto.mac(session_key, env.signing_bytes()))
         return env.with_signature(self.keypair.sign(env.signing_bytes()))
 
@@ -210,9 +229,22 @@ class MochiDBClient:
 
     async def execute_read_transaction(self, transaction: Transaction) -> TransactionResult:
         """1-round-trip read with per-op 2f+1 agreement
-        (ref: ``executeReadTransactionBL``, ``MochiDBClient.java:114-181``)."""
+        (ref: ``executeReadTransactionBL``, ``MochiDBClient.java:114-181``).
+
+        On quorum failure, a reconfiguration may have moved the keys off the
+        replica set this client still targets — adopt the newer committed
+        config if there is one and retry once.
+        """
+        try:
+            return await self._read_once(transaction)
+        except InconsistentRead:
+            if transaction.keys == (CONFIG_CLUSTER_KEY,) or not await self.refresh_config():
+                raise
+            return await self._read_once(transaction)
+
+    async def _read_once(self, transaction: Transaction) -> TransactionResult:
         with self.metrics.timer("read-transactions"):
-            nonce = uuid.uuid4().hex
+            nonce = new_msg_id()
             with self.metrics.timer("read-transactions-step1-future-wait"):
                 responses = await self._fan_out(
                     transaction,
@@ -249,6 +281,66 @@ class MochiDBClient:
                     )
                 final.append(best[1])
             return TransactionResult(tuple(final))
+
+    # -------------------------------------------------------- reconfiguration
+
+    async def refresh_config(self) -> bool:
+        """Pull the committed cluster config and adopt it if newer.
+
+        The config document rides the same 2f+1 quorum read as any value
+        (it was committed with a write certificate under the previous
+        configuration), so adopting it extends — not bypasses — the trust
+        chain.  Returns True if the config advanced.
+        """
+        txn = Transaction((Operation(Action.READ, CONFIG_CLUSTER_KEY),))
+        try:
+            result = await self.execute_read_transaction(txn)
+        except Exception:
+            return False
+        value = result.operations[0].value
+        if not value:
+            return False
+        try:
+            new_cfg = ClusterConfig.from_json(bytes(value).decode())
+        except Exception:
+            LOG.exception("committed cluster config unparseable")
+            return False
+        if new_cfg.configstamp <= self.config.configstamp:
+            return False
+        LOG.info(
+            "client adopting cluster config cs=%d (was %d)",
+            new_cfg.configstamp, self.config.configstamp,
+        )
+        self.config = new_cfg
+        # Sessions with surviving servers stay valid; new servers handshake
+        # lazily on first contact.
+        return True
+
+    async def reconfigure_cluster(self, new_config: ClusterConfig) -> None:
+        """Admin entry point: commit a new membership document.
+
+        Runs the paper's configuration-change protocol (mochiDB.tex:184-199)
+        over the standard 2-phase write: all current servers grant (the
+        _CONFIG_ keyspace is owned by every server), the certificate commits
+        the document, and each replica's apply hook installs it live.
+        """
+        if new_config.configstamp <= self.config.configstamp:
+            raise ValueError(
+                f"new configstamp {new_config.configstamp} must exceed "
+                f"current {self.config.configstamp}"
+            )
+        # One transaction commits the new membership AND archives the
+        # superseded config: fresh members joining later validate historical
+        # certificates against the archive (store.config_for_stamp).
+        archive_key = f"{CONFIG_ARCHIVE_PREFIX}{self.config.configstamp}"
+        txn = Transaction(
+            (
+                Operation(Action.WRITE, CONFIG_CLUSTER_KEY, new_config.to_json().encode()),
+                Operation(Action.WRITE, archive_key, self.config.to_json().encode()),
+            )
+        )
+        await self.execute_write_transaction(txn)
+        self.config = new_config
 
     # --------------------------------------------------------------- writes
 
@@ -378,7 +470,12 @@ class MochiDBClient:
                 # exists; refusals/outliers from up to f servers (contention,
                 # lag, Byzantine skew) must not block an honest quorum.
                 chosen = self._quorum_grant_subset(transaction, oks)
-                if chosen is not None:
+                if chosen is not None and not self._is_admin_txn(transaction):
+                    # Admin (config/archive) certificates keep ALL grants: a
+                    # fresh member bootstrapping years later must still find
+                    # 2f+1 signers it can resolve even after some of the
+                    # original signers were removed — the archive cert is
+                    # the root of its historical trust chain.
                     chosen = self._trim_to_quorum_cover(transaction, chosen)
                 if chosen is None:
                     # Seed collision with another in-flight transaction,
@@ -399,7 +496,17 @@ class MochiDBClient:
                     await asyncio.sleep(0.001 * (1 + attempt))
                     continue
                 certificate = WriteCertificate({mg.server_id: mg for mg in chosen})
-                return await self._write2(transaction, certificate)
+                try:
+                    return await self._write2(transaction, certificate)
+                except InconsistentWrite:
+                    # A reconfiguration may have landed between our phases
+                    # (replicas reject cross-config certificates).  Adopt
+                    # the newer config if there is one and retry; otherwise
+                    # the failure is real.
+                    if not await self.refresh_config():
+                        raise
+                    refusals += 1
+                    continue
             raise RequestRefused(f"write did not converge in {self.write_attempts} attempts")
 
     async def _nudge_laggards(

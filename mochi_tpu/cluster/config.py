@@ -35,6 +35,16 @@ PROPERTY_BFT_REPLICATION = "_CONFIG_BFT_REPLICATION"
 PROPERTY_SERVER_TOKENS = "_CONFIG_SERVER_{}_TOKENS"
 PROPERTY_SERVER_URL = "_CONFIG_SERVER_{}_URL"
 CONFIG_KEY_PREFIX = "_CONFIG_"  # keys routed to the config keyspace (ref: InMemoryDataStore.java:44)
+# The cluster-membership document itself, stored in the config keyspace and
+# committed through the normal 2-phase write protocol (paper's "Configuration
+# changes", mochiDB.tex:184-199 — declared, never implemented in the
+# reference).  Writing a higher-configstamp config here IS the reconfiguration.
+CONFIG_CLUSTER_KEY = CONFIG_KEY_PREFIX + "CLUSTER"
+# Immutable archive of superseded configs ("_CONFIG_CLUSTER_CS_<stamp>"),
+# written by the same reconfiguration transaction: certificates formed under
+# configstamp N are validated against config N, and fresh members learn the
+# historical configs from these keys during resync.
+CONFIG_ARCHIVE_PREFIX = CONFIG_CLUSTER_KEY + "_CS_"
 
 
 @dataclass(frozen=True)
@@ -91,6 +101,10 @@ class ClusterConfig:
     rf: int  # BFT replication factor (ref: _CONFIG_BFT_REPLICATION)
     configstamp: int = 1  # ref: ClusterConfiguration.java:41 (reconfiguration epoch)
     public_keys: Dict[str, bytes] = field(default_factory=dict)  # server_id -> Ed25519 pubkey (32B)
+    # Ed25519 public keys allowed to commit _CONFIG_CLUSTER* writes (the
+    # paper's "client with admin privilege", mochiDB.tex:191).  Empty = open
+    # (dev/test posture, matching the reference's total lack of auth).
+    admin_keys: List[bytes] = field(default_factory=list)
     # token -> replica set memo: the ring walk is O(SHARD_TOKENS) and sits on
     # every request's hot path (client targeting + server owns()/coalesce).
     # Invalidated implicitly by constructing a new config (reconfiguration
@@ -206,6 +220,64 @@ class ClusterConfig:
         cfg.validate()
         return cfg
 
+    def evolve(
+        self,
+        servers: Mapping[str, str],
+        public_keys: Mapping[str, bytes] | None = None,
+        rf: int | None = None,
+    ) -> "ClusterConfig":
+        """Next-configstamp config with the given membership.
+
+        Token movement is MINIMAL — the property the consistent-hash ring
+        exists for: surviving servers keep their tokens; only tokens of
+        removed servers are reassigned, and added servers steal an even
+        share (~1024/n) from the most-loaded members.  A full round-robin
+        re-deal would move ~(n-1)/n of all keys and trigger an O(n^2 *
+        store) resync storm.  Public keys of surviving members carry over;
+        new members must be supplied.
+        """
+        merged = {
+            sid: pk for sid, pk in self.public_keys.items() if sid in servers
+        }
+        merged.update(public_keys or {})
+        new_ids = sorted(servers)
+        owners = list(self.token_owners)
+        load: Dict[str, List[int]] = {sid: [] for sid in new_ids}
+        orphans: List[int] = []
+        for t, sid in enumerate(owners):
+            if sid in load:
+                load[sid].append(t)
+            else:
+                orphans.append(t)  # removed server's token
+        target = SHARD_TOKENS // len(new_ids)
+        # new/underloaded servers absorb orphans first, then steal from the
+        # most-loaded until everyone is within one of the target
+        for sid in new_ids:
+            while len(load[sid]) < target:
+                if orphans:
+                    t = orphans.pop()
+                else:
+                    donor = max(load, key=lambda s: len(load[s]))
+                    if len(load[donor]) <= target:
+                        break
+                    t = load[donor].pop()
+                owners[t] = sid
+                load[sid].append(t)
+        for t in orphans:  # leftovers (rounding) go to the least-loaded
+            sid = min(load, key=lambda s: len(load[s]))
+            owners[t] = sid
+            load[sid].append(t)
+        cfg = ClusterConfig(
+            servers={sid: ServerInfo.from_url(sid, url) for sid, url in servers.items()},
+            token_owners=owners,
+            rf=rf if rf is not None else self.rf,
+            public_keys=merged,
+        )
+        cfg.validate()
+        cfg.configstamp = self.configstamp + 1
+        cfg.admin_keys = list(self.admin_keys)
+        return cfg
+
     @classmethod
     def from_properties(cls, text: str) -> "ClusterConfig":
         """Parse the reference's Java-properties cluster file format
@@ -281,6 +353,7 @@ class ClusterConfig:
             rf=int(doc["rf"]),
             configstamp=int(doc.get("configstamp", 1)),
             public_keys=pubkeys,
+            admin_keys=[bytes.fromhex(h) for h in doc.get("admin_keys", [])],
         )
         cfg.validate()
         return cfg
@@ -293,5 +366,6 @@ class ClusterConfig:
                 "configstamp": self.configstamp,
                 "token_owners": self.token_owners,
                 "public_keys": {sid: pk.hex() for sid, pk in self.public_keys.items()},
+                "admin_keys": [pk.hex() for pk in self.admin_keys],
             }
         )

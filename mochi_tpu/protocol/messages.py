@@ -20,6 +20,7 @@ from __future__ import annotations
 import hashlib
 from dataclasses import dataclass, field, replace
 from enum import IntEnum
+from functools import cached_property
 from typing import Any, Dict, Optional, Tuple, Type
 
 from .codec import decode, encode
@@ -411,24 +412,29 @@ class SyncEntry:
 @dataclass(frozen=True)
 class SyncRequestToServer:
     """Pull request: give me your committed state for these keys (None = all
-    keys you own).  Pages of ``max_entries``, keys sorted ascending; pass the
-    last key of the previous page as ``after_key`` to continue."""
+    keys you hold).  Pages of ``max_entries``, keys sorted ascending; pass
+    the last key of the previous page as ``after_key`` to continue.
+    ``prefix`` filters server-side — resync pulls the ``_CONFIG_`` keyspace
+    FIRST so historical config archives are learned before the data
+    certificates that need them."""
 
     keys: Optional[Tuple[str, ...]] = None
     max_entries: int = 1024
     after_key: Optional[str] = None
+    prefix: Optional[str] = None
 
     def to_obj(self) -> Any:
         return [
             list(self.keys) if self.keys is not None else None,
             self.max_entries,
             self.after_key,
+            self.prefix,
         ]
 
     @classmethod
     def from_obj(cls, obj: Any) -> "SyncRequestToServer":
-        keys, max_entries, after_key = obj
-        return cls(tuple(keys) if keys is not None else None, max_entries, after_key)
+        keys, max_entries, after_key, prefix = obj
+        return cls(tuple(keys) if keys is not None else None, max_entries, after_key, prefix)
 
 
 @dataclass(frozen=True)
@@ -590,19 +596,34 @@ class Envelope:
     signature: Optional[bytes] = None
     mac: Optional[bytes] = None  # session MAC (``crypto/session.py``)
 
+    @cached_property
+    def _payload_obj(self) -> Any:
+        # Each envelope is encoded twice per side (auth bytes + wire bytes);
+        # the payload tree dominates both, so build it once.  Sound because
+        # payloads are frozen dataclasses.  cached_property writes straight
+        # to __dict__, bypassing the frozen __setattr__.
+        return self.payload.to_obj()
+
     def signing_bytes(self) -> bytes:
         """Canonical bytes covered by BOTH auth mechanisms (signature or
         session MAC) — everything except the auth fields themselves."""
         tag = _TAG_BY_TYPE[type(self.payload)]
         return b"mochi.env\x00" + encode(
-            [tag, self.payload.to_obj(), self.msg_id, self.sender_id, self.reply_to, self.timestamp_ms]
+            [tag, self._payload_obj, self.msg_id, self.sender_id, self.reply_to, self.timestamp_ms]
         )
 
+    def _with_cache(self, **changes) -> "Envelope":
+        env = replace(self, **changes)
+        cached = self.__dict__.get("_payload_obj")
+        if cached is not None:
+            env.__dict__["_payload_obj"] = cached
+        return env
+
     def with_signature(self, sig: bytes) -> "Envelope":
-        return replace(self, signature=sig)
+        return self._with_cache(signature=sig)
 
     def with_mac(self, tag: bytes) -> "Envelope":
-        return replace(self, mac=tag)
+        return self._with_cache(mac=tag)
 
 
 def encode_envelope(env: Envelope) -> bytes:
@@ -610,7 +631,7 @@ def encode_envelope(env: Envelope) -> bytes:
     return encode(
         [
             tag,
-            env.payload.to_obj(),
+            env._payload_obj,
             env.msg_id,
             env.sender_id,
             env.reply_to,

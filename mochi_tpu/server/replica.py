@@ -21,14 +21,13 @@ from __future__ import annotations
 import asyncio
 import logging
 import time
-import uuid
 from dataclasses import replace
 from typing import Dict, Iterable, Optional
 
-from ..cluster.config import ClusterConfig
+from ..cluster.config import CONFIG_CLUSTER_KEY, ClusterConfig
 from ..crypto import session as session_crypto
-from ..crypto.keys import KeyPair
-from ..net.transport import RpcClientPool, RpcServer
+from ..crypto.keys import KeyPair, verify as crypto_verify
+from ..net.transport import RpcClientPool, RpcServer, new_msg_id
 from ..protocol import (
     Envelope,
     FailType,
@@ -94,6 +93,9 @@ class MochiReplica:
         # HMAC cost; Ed25519 reserved for MultiGrants.  Lost on restart —
         # clients re-handshake when their MAC'd request bounces.
         self._sessions: Dict[str, bytes] = {}
+        # Reconfiguration (paper mochiDB.tex:184-199): a committed write to
+        # CONFIG_CLUSTER_KEY installs the new membership live.
+        self.store.on_config_value = self._install_config
 
     # ----------------------------------------------------------------- boot
 
@@ -104,6 +106,11 @@ class MochiReplica:
             n = persistence.load_snapshot(self.store, self.snapshot_path)
             if n:
                 self.metrics.mark("replica.snapshot-loaded", n)
+            # A snapshot may hold a newer committed membership than the boot
+            # config file — install it before serving.
+            sv = self.store._get(CONFIG_CLUSTER_KEY)
+            if sv is not None and sv.exists and sv.value:
+                self._install_config(sv.value)
         await self.rpc.start()
         if self.snapshot_path and self.snapshot_interval_s > 0:
             self._snapshot_task = asyncio.ensure_future(self._snapshot_loop())
@@ -159,6 +166,59 @@ class MochiReplica:
     def bound_port(self) -> int:
         return self.rpc.bound_port
 
+    # -------------------------------------------------------- reconfiguration
+
+    def _install_config(self, blob: bytes) -> None:
+        """Adopt a committed cluster config (called from the datastore's
+        apply hook and at boot).  The blob earned a 2f+1 write certificate
+        under the previous configuration, so its authenticity rides the same
+        quorum trust as any committed value — no extra signature needed.
+
+        Completes the paper's declared configuration-change protocol
+        (``mochiDB.tex:184-199``; ``Grant.configstamp``,
+        ``MochiProtocol.proto:110``; ``clusterConfigurationstamp``,
+        ``ClusterConfiguration.java:41`` — all vestigial in the reference).
+        The paper's bespoke config1/config2 rounds (write blocking + ack
+        majority) are subsumed by the standard Write1/Write2 path: the
+        config write carries a real certificate, and configstamp gating in
+        ``DataStore._coalesce_grants`` replaces the paper's per-message CS
+        equality check.
+        """
+        try:
+            new_cfg = ClusterConfig.from_json(
+                blob.decode() if isinstance(blob, (bytes, bytearray)) else blob
+            )
+        except Exception:
+            LOG.exception("committed cluster config is unparseable; ignoring")
+            return
+        if new_cfg.configstamp <= self.config.configstamp:
+            return  # stale or duplicate install
+        old = self.config
+        self.config = new_cfg
+        self.store.config = new_cfg
+        # Keep both in the history: certificates formed under either stamp
+        # remain checkable (store.config_for_stamp).
+        self.store.note_config(old)
+        self.store.note_config(new_cfg)
+        added = sorted(set(new_cfg.servers) - set(old.servers))
+        removed = sorted(set(old.servers) - set(new_cfg.servers))
+        LOG.info(
+            "installed cluster config cs=%d (was %d): +%s -%s",
+            new_cfg.configstamp, old.configstamp, added, removed,
+        )
+        self.metrics.mark("replica.config-installs")
+        if self.server_id not in new_cfg.servers:
+            LOG.warning(
+                "this server is not a member of config cs=%d — retired "
+                "(serving WRONG_SHARD until decommissioned)",
+                new_cfg.configstamp,
+            )
+        elif added or removed:
+            # Membership changed: token ownership moved — pull newly-owned
+            # keys from peers in the background.
+            self._pending_sync_keys.add("*")
+            self._kick_sync_worker()
+
     # ------------------------------------------------------------- envelopes
 
     def _sender_key(self, sender_id: str) -> Optional[bytes]:
@@ -187,10 +247,32 @@ class MochiReplica:
             )
         return ok
 
+    @staticmethod
+    def _is_admin_op(payload) -> bool:
+        txn = getattr(payload, "transaction", None)
+        return txn is not None and any(
+            op.key.startswith(CONFIG_CLUSTER_KEY) for op in txn.operations
+        )
+
+    def _admin_sig_ok(self, env: Envelope) -> bool:
+        """Authorization for _CONFIG_CLUSTER* writes (paper: "client with
+        admin privilege", mochiDB.tex:191).  Self-contained: the envelope
+        must be Ed25519-SIGNED by one of ``config.admin_keys`` — verified
+        directly against those keys, so an admin needs no entry in any
+        client registry, and a session MAC can never qualify (open-mode
+        sessions don't prove key ownership)."""
+        if env.signature is None or env.mac is not None:
+            return False
+        signing = env.signing_bytes()
+        return any(
+            crypto_verify(ak, signing, env.signature)
+            for ak in self.config.admin_keys
+        )
+
     def _respond(self, env: Envelope, payload, force_sign: bool = False) -> Envelope:
         response = Envelope(
             payload=payload,
-            msg_id=uuid.uuid4().hex,
+            msg_id=new_msg_id(),
             sender_id=self.server_id,
             reply_to=env.msg_id,
             timestamp_ms=int(time.time() * 1000),
@@ -211,12 +293,15 @@ class MochiReplica:
 
     async def handle_envelope(self, env: Envelope) -> Optional[Envelope]:
         """Typed dispatch (ref: ``RequestHandlerDispatcher.java:44-61``)."""
-        if not await self._authenticate(env):
+        payload = env.payload
+        admin_gated = bool(self.config.admin_keys) and self._is_admin_op(payload)
+        if admin_gated and self._admin_sig_ok(env):
+            pass  # a valid admin signature IS authentication (and stronger)
+        elif not await self._authenticate(env):
             self.metrics.mark("replica.bad-signature")
             return self._respond(
                 env, RequestFailedFromServer(FailType.BAD_SIGNATURE, "envelope signature invalid")
             )
-        payload = env.payload
         if isinstance(payload, SessionInitToServer):
             # The ack must be Ed25519-SIGNED (not MAC'd): its signature is
             # what proves to the initiator that no MITM swapped X25519 keys.
@@ -245,7 +330,25 @@ class MochiReplica:
             with self.metrics.timer("replica.read"):
                 result = self.store.process_read(payload.transaction)
             return self._respond(
-                env, ReadFromServer(result, payload.nonce, rid=uuid.uuid4().hex)
+                env, ReadFromServer(result, payload.nonce, rid=new_msg_id())
+            )
+        if (
+            self.config.admin_keys
+            and isinstance(payload, (Write1ToServer, Write2ToServer))
+            and self._is_admin_op(payload)
+            and not self._admin_sig_ok(env)
+        ):
+            self.metrics.mark("replica.admin-denied")
+            # BAD_REQUEST, not BAD_SIGNATURE: this is authorization, and a
+            # BAD_SIGNATURE would trip the client's lost-session heuristic
+            # (tearing down valid MAC sessions on every denial).
+            return self._respond(
+                env,
+                RequestFailedFromServer(
+                    FailType.BAD_REQUEST,
+                    "cluster reconfiguration requires a signed envelope from "
+                    "an admin key (config.admin_keys)",
+                ),
             )
         if isinstance(payload, Write1ToServer):
             with self.metrics.timer("replica.write1"):
@@ -273,13 +376,22 @@ class MochiReplica:
                         ),
                     )
                 result = self.store.process_write2(replace(payload, write_certificate=checked))
+            if (
+                isinstance(result, RequestFailedFromServer)
+                and "configstamp ahead" in result.detail
+            ):
+                # The cluster reconfigured past us — catch up in the
+                # background (the client retries meanwhile).
+                self._pending_sync_keys.add(CONFIG_CLUSTER_KEY)
+                self._kick_sync_worker()
             return self._respond(env, result)
         if isinstance(payload, SyncRequestToServer):
             # Serve committed state for transfer.  No trust needed on either
             # side: entries are (transaction, certificate) pairs the receiver
             # re-validates via the Write2 checks.
             entries = self.store.export_sync_entries(
-                payload.keys, min(payload.max_entries, 1024), payload.after_key
+                payload.keys, min(payload.max_entries, 1024), payload.after_key,
+                payload.prefix,
             )
             return self._respond(env, SyncEntriesFromServer(tuple(entries)))
         if isinstance(payload, NudgeSyncToServer):
@@ -312,14 +424,15 @@ class MochiReplica:
             batch = set(list(self._pending_sync_keys)[:1024])
             self._pending_sync_keys -= batch
             try:
-                await self.resync(batch)
+                # "*" = full resync (post-reconfiguration ownership changes)
+                await self.resync(None if "*" in batch else batch)
             except Exception:
                 LOG.exception("background resync failed")
 
     def _signed_request(self, payload) -> Envelope:
         env = Envelope(
             payload=payload,
-            msg_id=uuid.uuid4().hex,
+            msg_id=new_msg_id(),
             sender_id=self.server_id,
             timestamp_ms=int(time.time() * 1000),
         )
@@ -350,11 +463,11 @@ class MochiReplica:
         ]
         advanced_keys: set = set()
 
-        async def pull_peer(info) -> None:
+        async def pull_peer(info, prefix: Optional[str]) -> None:
             after: Optional[str] = None
             while True:  # page until a short page (or error/foreign payload)
                 request = SyncRequestToServer(
-                    keys=key_tuple, max_entries=page, after_key=after
+                    keys=key_tuple, max_entries=page, after_key=after, prefix=prefix
                 )
                 try:
                     res = await self.peer_pool.send_and_receive(
@@ -379,7 +492,17 @@ class MochiReplica:
                 after = entries[-1].key
 
         with self.metrics.timer("replica.resync"):
-            await asyncio.gather(*(pull_peer(info) for info in peers))
+            # Pass 1: the _CONFIG_ keyspace alone — historical config
+            # archives must be learned BEFORE the data certificates that are
+            # validated against them (store.config_for_stamp), regardless of
+            # key sort order.
+            from ..cluster.config import CONFIG_KEY_PREFIX
+
+            await asyncio.gather(
+                *(pull_peer(info, CONFIG_KEY_PREFIX) for info in peers)
+            )
+            # Pass 2: everything (config keys re-apply as no-ops).
+            await asyncio.gather(*(pull_peer(info, None) for info in peers))
         if advanced_keys:
             LOG.info("resync advanced %d objects", len(advanced_keys))
             self.metrics.mark("replica.resync-applied", len(advanced_keys))
@@ -393,18 +516,30 @@ class MochiReplica:
         This is the quorum-cert aggregation hot path: 2f+1 signature checks
         per Write2, batched into one verifier call.
         """
+        # Signer keys come from the configuration the certificate was formed
+        # under (a server removed since then still signed validly THEN; a
+        # fresh member learns old keys from the committed config archive).
+        # Same resolution the quorum layer uses — store.cert_config.
+        cert_cfg = self.store.cert_config(wc)
         server_ids = list(wc.grants.keys())
         items = []
-        for sid in server_ids:
+        valid = [False] * len(server_ids)
+        for i, sid in enumerate(server_ids):
             mg = wc.grants[sid]
-            key = self.config.public_keys.get(sid)
+            key = cert_cfg.public_keys.get(sid)
             if key is None or mg.signature is None or mg.server_id != sid:
+                items.append(None)
+                continue
+            if sid == self.server_id:
+                # Our own grant: Ed25519 is deterministic (RFC 8032), so
+                # re-signing the canonical bytes and comparing equals a
+                # verify at a third of the cost — and stays off the batch.
+                valid[i] = self.keypair.sign(mg.signing_bytes()) == mg.signature
                 items.append(None)
                 continue
             items.append(VerifyItem(key, mg.signing_bytes(), mg.signature))
         real = [(i, it) for i, it in enumerate(items) if it is not None]
         bitmap = await self.verifier.verify_batch([it for _, it in real]) if real else []
-        valid = [False] * len(server_ids)
         for (i, _), ok in zip(real, bitmap):
             valid[i] = ok
         kept = {sid: wc.grants[sid] for sid, ok in zip(server_ids, valid) if ok}

@@ -34,7 +34,12 @@ import logging
 from dataclasses import dataclass, field as dc_field
 from typing import Dict, Iterable, List, Optional, Tuple, Union
 
-from ..cluster.config import CONFIG_KEY_PREFIX, ClusterConfig
+from ..cluster.config import (
+    CONFIG_ARCHIVE_PREFIX,
+    CONFIG_CLUSTER_KEY,
+    CONFIG_KEY_PREFIX,
+    ClusterConfig,
+)
 from ..protocol import (
     Action,
     FailType,
@@ -170,6 +175,17 @@ class DataStore:
         self.config = config
         self.data: Dict[str, StoreValue] = {}
         self.data_config: Dict[str, StoreValue] = {}  # _CONFIG_ keyspace
+        # Fired (post-apply, same event-loop turn) when a write commits to
+        # CONFIG_CLUSTER_KEY — the replica installs the new membership
+        # (paper's configuration change, mochiDB.tex:184-199).
+        self.on_config_value = None  # Optional[Callable[[bytes], None]]
+        # configstamp -> config, for validating certificates formed under
+        # PREVIOUS configurations (resync replays them; their quorum shape
+        # is the one they were granted under).  Live replicas accumulate
+        # entries as they witness installs; fresh members fall back to the
+        # archived config documents (CONFIG_ARCHIVE_PREFIX keys, written by
+        # the reconfiguration transaction itself).
+        self.config_history: Dict[int, ClusterConfig] = {config.configstamp: config}
 
     # ------------------------------------------------------------------ util
 
@@ -193,6 +209,34 @@ class DataStore:
     def _cert_ts(self, sv: StoreValue) -> Optional[int]:
         """``certificate_timestamp`` restricted to the key's replica set."""
         return sv.certificate_timestamp(set(self.config.replica_set_for_key(sv.key)))
+
+    def note_config(self, cfg: ClusterConfig) -> None:
+        """Record a configuration in the history (replica install hook)."""
+        self.config_history[cfg.configstamp] = cfg
+
+    def config_for_stamp(self, cs: int) -> Optional[ClusterConfig]:
+        """The configuration in force at configstamp ``cs`` (or None).
+
+        Order: current, witnessed history, then the committed archive
+        document — which is how a freshly-booted member (it never witnessed
+        the older installs) validates historical certificates during resync.
+        """
+        if cs == self.config.configstamp:
+            return self.config
+        cached = self.config_history.get(cs)
+        if cached is not None:
+            return cached
+        sv = self.data_config.get(f"{CONFIG_ARCHIVE_PREFIX}{cs}")
+        if sv is not None and sv.exists and sv.value:
+            try:
+                cfg = ClusterConfig.from_json(bytes(sv.value).decode())
+            except Exception:
+                LOG.exception("archived config cs=%d unparseable", cs)
+                return None
+            if cfg.configstamp == cs:
+                self.config_history[cs] = cfg
+                return cfg
+        return None
 
     def stats(self) -> Dict[str, int]:
         """Operator-facing counters (served by the admin HTTP shell)."""
@@ -274,9 +318,28 @@ class DataStore:
 
     # ---------------------------------------------------------------- write2
 
+    def _cert_stamp(self, wc: WriteCertificate) -> Optional[int]:
+        """The certificate's configstamp (from its first OK grant)."""
+        for mg in wc.grants.values():
+            for g in mg.grants.values():
+                if g.status == Status.OK:
+                    return g.configstamp
+        return None
+
+    def cert_config(self, wc: WriteCertificate) -> ClusterConfig:
+        """The configuration a certificate must be judged against: the one
+        in force at its configstamp, falling back to the current config for
+        unknown stamps.  Single source of truth for BOTH the signature layer
+        (which keys signed) and the quorum layer (which sets/quorum count) —
+        the two verdicts must never diverge for one certificate."""
+        stamp = self._cert_stamp(wc)
+        if stamp is None:
+            return self.config
+        return self.config_for_stamp(stamp) or self.config
+
     def _coalesce_grants(
         self, wc: WriteCertificate, transaction: Transaction
-    ) -> Dict[str, Tuple[int, List[Grant]]]:
+    ) -> Tuple[Dict[str, Tuple[int, List[Grant]]], ClusterConfig]:
         """Group certificate grants per object; timestamps must agree across
         servers (ref: ``processMultiGrantsFromAllServers``,
         ``InMemoryDataStore.java:613-640``).
@@ -286,10 +349,28 @@ class DataStore:
         set) says nothing about servers outside the set, so a grant from an
         out-of-set server — however validly signed — must not contribute to
         the quorum.
+
+        Configstamp gating (the paper's CS check, mochiDB.tex:186-189): a
+        certificate must be formed under ONE configuration — mixed
+        configstamps are rejected — and a configstamp AHEAD of ours means
+        the cluster reconfigured and we haven't caught up (the replica
+        schedules a config resync and refuses for now).  Configstamps
+        BEHIND ours stay acceptable — resync replays historical
+        certificates after a reconfiguration moves keys — and are judged
+        against the replica sets and quorum OF THEIR OWN configuration
+        (:meth:`config_for_stamp`): a certificate's validity is a fact about
+        the configuration it was granted under, not about today's ring.
         """
+        stamp_seen = self._cert_stamp(wc)
+        if stamp_seen is not None and stamp_seen > self.config.configstamp:
+            raise BadCertificate(
+                f"configstamp ahead: grant cs={stamp_seen} > "
+                f"ours {self.config.configstamp}"
+            )
+        cert_cfg = self.cert_config(wc)
         coalesced: Dict[str, Tuple[int, List[Grant]]] = {}
         replica_sets = {
-            op.key: set(self.config.replica_set_for_key(op.key))
+            op.key: set(cert_cfg.replica_set_for_key(op.key))
             for op in transaction.operations
         }
         # One vote per (key, server): iterate unique keys, and dedupe
@@ -303,6 +384,8 @@ class DataStore:
                     continue
                 if mg.server_id not in rset or mg.server_id in seen[key]:
                     continue
+                if grant.configstamp != stamp_seen:
+                    raise BadCertificate("mixed configstamps in certificate")
                 seen[key].add(mg.server_id)
                 entry = coalesced.get(key)
                 if entry is None:
@@ -311,7 +394,7 @@ class DataStore:
                     raise BadCertificate(f"grant timestamps disagree for {key}")
                 else:
                     entry[1].append(grant)
-        return coalesced
+        return coalesced, cert_cfg
 
     def process_write2(self, req: Write2ToServer) -> Write2Response:
         """Verify certificate shape and apply the transaction
@@ -320,7 +403,7 @@ class DataStore:
         transaction = req.transaction
         txn_hash = transaction_hash(transaction)
         try:
-            coalesced = self._coalesce_grants(req.write_certificate, transaction)
+            coalesced, cert_cfg = self._coalesce_grants(req.write_certificate, transaction)
         except BadCertificate as exc:
             return RequestFailedFromServer(FailType.BAD_CERTIFICATE, str(exc))
 
@@ -340,11 +423,12 @@ class DataStore:
                 )
             ts, grant_list = entry
             # Quorum: >= 2f+1 distinct-server grants (fixes the strict-'>' at
-            # InMemoryDataStore.java:590).
-            if len(grant_list) < self.config.quorum:
+            # InMemoryDataStore.java:590), measured against the certificate's
+            # own configuration (see _coalesce_grants).
+            if len(grant_list) < cert_cfg.quorum:
                 return RequestFailedFromServer(
                     FailType.BAD_CERTIFICATE,
-                    f"{len(grant_list)} grants < quorum {self.config.quorum} for {op.key}",
+                    f"{len(grant_list)} grants < quorum {cert_cfg.quorum} for {op.key}",
                 )
             if any(g.transaction_hash != txn_hash for g in grant_list):
                 return RequestFailedFromServer(
@@ -386,6 +470,16 @@ class DataStore:
         else:
             sv.value = None
             sv.exists = False
+        if (
+            op.key == CONFIG_CLUSTER_KEY
+            and op.action == Action.WRITE
+            and op.value
+            and self.on_config_value is not None
+        ):
+            try:
+                self.on_config_value(op.value)
+            except Exception:
+                LOG.exception("config install hook failed")
         return OperationResult(op.value, wc, existed_before, Status.OK)
 
     # ----------------------------------------------------------------- sync
@@ -395,14 +489,20 @@ class DataStore:
         keys: Optional[Iterable[str]] = None,
         max_entries: int = 1024,
         after_key: Optional[str] = None,
+        prefix: Optional[str] = None,
     ) -> List[SyncEntry]:
         """Committed (transaction, certificate) pairs for state transfer.
 
-        Serves the paper's UptoSpeed (``mochiDB.tex:168-169``).  Only owned
-        keys with a commit history are exported; each entry is independently
-        verifiable by the receiver.  Keys are walked in sorted order so
-        callers can page with ``after_key`` (resync loops until a short
-        page); both keyspaces (data + ``_CONFIG_``) are covered.
+        Serves the paper's UptoSpeed (``mochiDB.tex:168-169``).  Any key
+        with a commit history is exported — deliberately NOT restricted to
+        keys this server currently owns: after a reconfiguration re-deals
+        the token ring, a moved key's old holders no longer own it, yet they
+        are exactly the nodes that must hand it to the new owner.  Safe
+        because every entry carries its own (transaction, certificate)
+        proof; the receiver enforces its own ownership and re-validates.
+        Keys are walked in sorted order so callers can page with
+        ``after_key`` (resync loops until a short page); both keyspaces
+        (data + ``_CONFIG_``) are covered.
         """
         if keys is None:
             candidates: Iterable[str] = sorted(
@@ -414,10 +514,10 @@ class DataStore:
         for key in candidates:
             if after_key is not None and key <= after_key:
                 continue
+            if prefix is not None and not key.startswith(prefix):
+                continue
             if len(out) >= max_entries:
                 break
-            if not self.owns(key):
-                continue
             sv = self._get(key)
             if sv is None or sv.current_certificate is None or sv.last_transaction is None:
                 continue
