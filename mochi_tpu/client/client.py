@@ -26,10 +26,10 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..cluster.config import (
-    CONFIG_ARCHIVE_PREFIX,
     CONFIG_CLUSTER_KEY,
     ClusterConfig,
     ServerInfo,
+    config_archive_key,
 )
 from ..crypto import session as session_crypto
 from ..crypto.keys import KeyPair, generate_keypair, verify as cpu_verify
@@ -329,14 +329,25 @@ class MochiDBClient:
                 f"new configstamp {new_config.configstamp} must exceed "
                 f"current {self.config.configstamp}"
             )
-        # One transaction commits the new membership AND archives the
-        # superseded config: fresh members joining later validate historical
-        # certificates against the archive (store.config_for_stamp).
-        archive_key = f"{CONFIG_ARCHIVE_PREFIX}{self.config.configstamp}"
+        # One transaction commits the new membership AND two archives:
+        # the superseded config under its stamp (historical-certificate
+        # validation, store.config_for_stamp) and the NEW config under ITS
+        # stamp — the forward catch-up rung: this entry's certificate is
+        # stamped with the OLD configstamp, so a replica that only knows
+        # config N can validate-and-install N+1, then N+2, ... in one
+        # sorted resync sweep (no wedge after missing several reconfigs).
+        new_blob = new_config.to_json().encode()
         txn = Transaction(
             (
-                Operation(Action.WRITE, CONFIG_CLUSTER_KEY, new_config.to_json().encode()),
-                Operation(Action.WRITE, archive_key, self.config.to_json().encode()),
+                Operation(Action.WRITE, CONFIG_CLUSTER_KEY, new_blob),
+                Operation(
+                    Action.WRITE,
+                    config_archive_key(self.config.configstamp),
+                    self.config.to_json().encode(),
+                ),
+                Operation(
+                    Action.WRITE, config_archive_key(new_config.configstamp), new_blob
+                ),
             )
         )
         await self.execute_write_transaction(txn)

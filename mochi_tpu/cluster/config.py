@@ -40,11 +40,19 @@ CONFIG_KEY_PREFIX = "_CONFIG_"  # keys routed to the config keyspace (ref: InMem
 # changes", mochiDB.tex:184-199 — declared, never implemented in the
 # reference).  Writing a higher-configstamp config here IS the reconfiguration.
 CONFIG_CLUSTER_KEY = CONFIG_KEY_PREFIX + "CLUSTER"
-# Immutable archive of superseded configs ("_CONFIG_CLUSTER_CS_<stamp>"),
-# written by the same reconfiguration transaction: certificates formed under
-# configstamp N are validated against config N, and fresh members learn the
-# historical configs from these keys during resync.
+# Immutable archive of configs by stamp ("_CONFIG_CLUSTER_CS_<stamp>",
+# zero-padded so string sort == numeric sort), written by the
+# reconfiguration transaction itself.  Two roles: (a) certificates formed
+# under configstamp N are validated against config N; (b) the FORWARD
+# catch-up chain — the reconfig i->i+1 transaction archives doc(i+1) under
+# a certificate stamped i, so a replica that knows config i can validate
+# and install i+1, then i+2, ... in one sorted sweep (a laggard that missed
+# several reconfigurations is never wedged).
 CONFIG_ARCHIVE_PREFIX = CONFIG_CLUSTER_KEY + "_CS_"
+
+
+def config_archive_key(configstamp: int) -> str:
+    return f"{CONFIG_ARCHIVE_PREFIX}{configstamp:010d}"
 
 
 @dataclass(frozen=True)

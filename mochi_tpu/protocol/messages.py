@@ -433,7 +433,9 @@ class SyncRequestToServer:
 
     @classmethod
     def from_obj(cls, obj: Any) -> "SyncRequestToServer":
-        keys, max_entries, after_key, prefix = obj
+        # tolerate the 3-field pre-prefix wire form (rolling upgrades)
+        keys, max_entries, after_key = obj[:3]
+        prefix = obj[3] if len(obj) > 3 else None
         return cls(tuple(keys) if keys is not None else None, max_entries, after_key, prefix)
 
 

@@ -492,15 +492,25 @@ class MochiReplica:
                 after = entries[-1].key
 
         with self.metrics.timer("replica.resync"):
-            # Pass 1: the _CONFIG_ keyspace alone — historical config
+            # Pass 1 (x2): the _CONFIG_ keyspace alone — historical config
             # archives must be learned BEFORE the data certificates that are
             # validated against them (store.config_for_stamp), regardless of
-            # key sort order.
+            # key sort order.  Run twice: the first sweep walks the archive
+            # catch-up chain (each install enables validating the next
+            # stamp); the second then imports entries — notably the
+            # CONFIG_CLUSTER document itself — whose certificates only
+            # became checkable after the chain completed.  Skipped entirely
+            # for targeted resyncs that name no config key.
             from ..cluster.config import CONFIG_KEY_PREFIX
 
-            await asyncio.gather(
-                *(pull_peer(info, CONFIG_KEY_PREFIX) for info in peers)
+            config_pass = key_tuple is None or any(
+                k.startswith(CONFIG_KEY_PREFIX) for k in key_tuple
             )
+            if config_pass:
+                for _ in range(2):
+                    await asyncio.gather(
+                        *(pull_peer(info, CONFIG_KEY_PREFIX) for info in peers)
+                    )
             # Pass 2: everything (config keys re-apply as no-ops).
             await asyncio.gather(*(pull_peer(info, None) for info in peers))
         if advanced_keys:

@@ -39,6 +39,7 @@ from ..cluster.config import (
     CONFIG_CLUSTER_KEY,
     CONFIG_KEY_PREFIX,
     ClusterConfig,
+    config_archive_key,
 )
 from ..protocol import (
     Action,
@@ -226,7 +227,7 @@ class DataStore:
         cached = self.config_history.get(cs)
         if cached is not None:
             return cached
-        sv = self.data_config.get(f"{CONFIG_ARCHIVE_PREFIX}{cs}")
+        sv = self.data_config.get(config_archive_key(cs))
         if sv is not None and sv.exists and sv.value:
             try:
                 cfg = ClusterConfig.from_json(bytes(sv.value).decode())
@@ -237,6 +238,24 @@ class DataStore:
                 self.config_history[cs] = cfg
                 return cfg
         return None
+
+    def nearest_config_for_stamp(self, cs: int) -> ClusterConfig:
+        """Best-effort config for a stamp with no exact record: the nearest
+        known stamp (preferring the closest at-or-below, then the lowest
+        above).  Judging an old certificate with a nearby config relies on
+        the bounded-churn-per-epoch property consecutive BFT configurations
+        must have anyway (>= 2f+1 member overlap); the further the distance,
+        the more likely valid historical certificates fail — a documented
+        limit for members that join after many membership-churning
+        reconfigurations (boot them from a snapshot instead)."""
+        exact = self.config_for_stamp(cs)
+        if exact is not None:
+            return exact
+        known = sorted(self.config_history)
+        below = [s for s in known if s <= cs]
+        if below:
+            return self.config_history[below[-1]]
+        return self.config_history[known[0]] if known else self.config
 
     def stats(self) -> Dict[str, int]:
         """Operator-facing counters (served by the admin HTTP shell)."""
@@ -335,7 +354,7 @@ class DataStore:
         stamp = self._cert_stamp(wc)
         if stamp is None:
             return self.config
-        return self.config_for_stamp(stamp) or self.config
+        return self.nearest_config_for_stamp(stamp)
 
     def _coalesce_grants(
         self, wc: WriteCertificate, transaction: Transaction
@@ -396,6 +415,48 @@ class DataStore:
                     entry[1].append(grant)
         return coalesced, cert_cfg
 
+    def _validate_config_write(self, op: Operation) -> Optional[str]:
+        """Structural checks for writes into the cluster-config keyspace.
+
+        Returns an error detail (None = fine).  Prevents the committed
+        membership document diverging from what replicas installed: a
+        CONFIG_CLUSTER doc must be exactly current-stamp (idempotent
+        replay/resync) or current+1 (the next reconfiguration) — a stale
+        concurrent admin write with an old stamp is refused instead of
+        silently overwriting the document replicas never installed.
+        Archive entries must carry the config matching their key's stamp.
+        Deletes of config-cluster keys are never allowed.
+        """
+        if op.key != CONFIG_CLUSTER_KEY and not op.key.startswith(CONFIG_ARCHIVE_PREFIX):
+            return None
+        if op.action == Action.DELETE:
+            return f"delete of {op.key} not permitted"
+        if op.action != Action.WRITE:
+            return None
+        if not op.value:
+            return f"empty config document for {op.key}"
+        try:
+            doc = ClusterConfig.from_json(bytes(op.value).decode())
+        except Exception as exc:
+            return f"unparseable config document for {op.key}: {exc}"
+        current = self.config.configstamp
+        if op.key == CONFIG_CLUSTER_KEY:
+            if doc.configstamp not in (current, current + 1):
+                return (
+                    f"non-sequential config: doc cs={doc.configstamp}, "
+                    f"ours {current} (want {current} or {current + 1})"
+                )
+            return None
+        try:
+            key_stamp = int(op.key[len(CONFIG_ARCHIVE_PREFIX):])
+        except ValueError:
+            return f"malformed archive key {op.key}"
+        if doc.configstamp != key_stamp:
+            return f"archive {op.key} holds doc cs={doc.configstamp}"
+        if doc.configstamp > current + 1:
+            return f"archive cs={doc.configstamp} too far ahead of {current}"
+        return None
+
     def process_write2(self, req: Write2ToServer) -> Write2Response:
         """Verify certificate shape and apply the transaction
         (ref: ``processWrite2ToServer`` + ``write2apply``,
@@ -434,6 +495,9 @@ class DataStore:
                 return RequestFailedFromServer(
                     FailType.BAD_CERTIFICATE, f"transaction hash mismatch for {op.key}"
                 )
+            config_err = self._validate_config_write(op)
+            if config_err is not None:
+                return RequestFailedFromServer(FailType.BAD_REQUEST, config_err)
             sv = self._get_or_create(op.key)
             current_ts = self._cert_ts(sv)
             if current_ts is not None and current_ts > ts:
@@ -471,11 +535,15 @@ class DataStore:
             sv.value = None
             sv.exists = False
         if (
-            op.key == CONFIG_CLUSTER_KEY
+            (op.key == CONFIG_CLUSTER_KEY or op.key.startswith(CONFIG_ARCHIVE_PREFIX))
             and op.action == Action.WRITE
             and op.value
             and self.on_config_value is not None
         ):
+            # Fires for archive keys too: during resync catch-up the chain
+            # rung _CS_<k+1> (not the head document, whose certificate is
+            # still "ahead") is what advances a laggard's configstamp.
+            # _install_config ignores stale/duplicate stamps.
             try:
                 self.on_config_value(op.value)
             except Exception:

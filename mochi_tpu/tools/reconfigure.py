@@ -37,14 +37,23 @@ async def amain(args) -> None:
     )
     servers = {sid: info.url for sid, info in cfg.servers.items()}
     pubkeys = {}
+    added = []
     for spec in args.add or []:
         sid, _, url = spec.partition("=")
         if not url:
             raise SystemExit(f"--add wants server-id=host:port, got {spec!r}")
         servers[sid] = url
+        added.append(sid)
     for spec in args.pubkey or []:
         sid, _, hexkey = spec.partition("=")
         pubkeys[sid] = bytes.fromhex(hexkey)
+    missing = [sid for sid in added if sid not in pubkeys]
+    if missing:
+        # A member without a public key could never sign a verifiable grant:
+        # its shards would silently run with zero slack over quorum.
+        raise SystemExit(
+            f"--add requires --pubkey {missing[0]}=<hex> for: {', '.join(missing)}"
+        )
     for sid in args.remove or []:
         if sid not in servers:
             raise SystemExit(f"--remove {sid}: not a member")

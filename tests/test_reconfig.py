@@ -280,6 +280,91 @@ def test_admin_gating_blocks_non_admin_reconfig():
     run(main())
 
 
+def test_laggard_catches_up_after_two_missed_reconfigs():
+    """A replica offline through cs=1->2->3 must walk the archive catch-up
+    chain (each rung's certificate is stamped with the PREVIOUS config) and
+    end at cs=3 with its data — the permanent-wedge scenario from review."""
+
+    async def main():
+        async with VirtualCluster(5, rf=4) as vc:
+            client = vc.client()
+            await client.execute_write_transaction(
+                TransactionBuilder().write("survivor", b"v1").build()
+            )
+            # take server-4 down; reconfigure TWICE without it (membership
+            # unchanged — stamps 2 and 3)
+            victim = vc.replica("server-4")
+            victim_port = victim.bound_port
+            await victim.close()
+            urls = {sid: info.url for sid, info in vc.config.servers.items()}
+            await client.reconfigure_cluster(vc.config.evolve(urls))
+            client.config = vc.replicas[0].config
+            await client.reconfigure_cluster(client.config.evolve(urls))
+            assert vc.replicas[0].config.configstamp == 3
+
+            # server-4 comes back at cs=1 and resyncs
+            fresh = MochiReplica(
+                server_id="server-4",
+                config=ClusterConfig.from_json(victim.config.to_json())
+                if victim.config.configstamp == 1
+                else vc.config,
+                keypair=vc.keypairs["server-4"],
+                client_public_keys=vc.client_keys,
+                host=vc.host,
+                port=victim_port,
+            )
+            # force its view back to cs=1 regardless of shared-object drift
+            base = ClusterConfig.from_json(vc.config.to_json())
+            base.configstamp = 1
+            fresh.config = base
+            fresh.store.config = base
+            fresh.store.config_history = {1: base}
+            await fresh.start()
+            vc.replicas[vc.replicas.index(victim)] = fresh
+
+            await fresh.resync()
+            assert fresh.config.configstamp == 3, fresh.config.configstamp
+            sv = fresh.store._get("survivor")
+            assert sv is not None and sv.exists
+
+    run(main())
+
+
+def test_non_sequential_config_write_rejected():
+    """A concurrent/stale admin commit whose document stamp is not current
+    or current+1 must be refused — otherwise the stored membership document
+    diverges from what replicas installed (split-brain from review)."""
+    from mochi_tpu.protocol import (
+        Action, Grant, MultiGrant, Operation, RequestFailedFromServer,
+        Status, Transaction, Write2ToServer, WriteCertificate,
+        transaction_hash,
+    )
+    from mochi_tpu.server.store import DataStore
+
+    cfg = ClusterConfig.build(
+        {f"s{i}": f"127.0.0.1:{9400+i}" for i in range(4)}, rf=4
+    )
+    ds = DataStore("s0", cfg)
+    bad_doc = ClusterConfig.build(
+        {f"s{i}": f"127.0.0.1:{9400+i}" for i in range(4)}, rf=4
+    )
+    bad_doc.configstamp = 7  # far from current 1
+    txn = Transaction(
+        (Operation(Action.WRITE, CONFIG_CLUSTER_KEY, bad_doc.to_json().encode()),)
+    )
+    h = transaction_hash(txn)
+    wc = WriteCertificate({
+        f"s{i}": MultiGrant(
+            {CONFIG_CLUSTER_KEY: Grant(CONFIG_CLUSTER_KEY, 500, 1, h, Status.OK)},
+            "c", f"s{i}",
+        )
+        for i in range(3)
+    })
+    resp = ds.process_write2(Write2ToServer(wc, txn))
+    assert isinstance(resp, RequestFailedFromServer)
+    assert "non-sequential" in resp.detail
+
+
 def test_evolve_carries_keys_and_bumps_stamp():
     kp = generate_keypair()
     cfg = ClusterConfig.build(
