@@ -90,7 +90,10 @@ def main(argv) -> None:
         baseline_path = os.path.join(_REPO, "BASELINE.json")
         with open(baseline_path) as fh:
             baseline = json.load(fh)
-        baseline["published"] = {
+        # merge, don't replace: re-publishing one config must not erase the
+        # others' published entries
+        baseline.setdefault("published", {})
+        baseline["published"].update({
             r["config"]: {
                 k: v
                 for k, v in r.items()
@@ -99,7 +102,7 @@ def main(argv) -> None:
                 and v is not None
             }
             for r in results
-        }
+        })
         with open(baseline_path, "w") as fh:
             json.dump(baseline, fh, indent=2)
         print(f"published -> {out_path} and BASELINE.json", file=sys.stderr)

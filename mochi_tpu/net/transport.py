@@ -71,6 +71,16 @@ class RpcServer:
         assert self._server is not None
         return self._server.sockets[0].getsockname()[1]
 
+    # Payload types whose handlers never block on external work (no device
+    # batches, no peer RPC): handled INLINE on the connection's read loop,
+    # saving a Task allocation + schedule per message.  Only taken for
+    # MAC'd envelopes — session-MAC auth is synchronous, while signed
+    # envelopes may await the batch verifier (blocking the read loop there
+    # would serialize the very requests the batcher wants to coalesce).
+    # Everything else (Write2's certificate batch, sync pulls) gets its own
+    # task so a slow request can't head-of-line-block the channel.
+    INLINE_TYPES = ("ReadToServer", "Write1ToServer", "HelloToServer")
+
     async def _serve_conn(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
@@ -86,6 +96,9 @@ class RpcServer:
                 except Exception:
                     LOG.exception("undecodable frame from %s; closing", peer)
                     break
+                if env.mac is not None and type(env.payload).__name__ in self.INLINE_TYPES:
+                    await self._handle_one(env, writer, write_lock)
+                    continue
                 # Handle concurrently so one slow request (e.g. awaiting a
                 # verification batch) doesn't head-of-line-block the channel.
                 task = asyncio.ensure_future(self._handle_one(env, writer, write_lock))

@@ -463,11 +463,13 @@ class MochiReplica:
         ]
         advanced_keys: set = set()
 
-        async def pull_peer(info, prefix: Optional[str]) -> None:
+        async def pull_peer(
+            info, prefix: Optional[str], req_keys: "Optional[tuple]" = None
+        ) -> None:
             after: Optional[str] = None
             while True:  # page until a short page (or error/foreign payload)
                 request = SyncRequestToServer(
-                    keys=key_tuple, max_entries=page, after_key=after, prefix=prefix
+                    keys=req_keys, max_entries=page, after_key=after, prefix=prefix
                 )
                 try:
                     res = await self.peer_pool.send_and_receive(
@@ -507,12 +509,15 @@ class MochiReplica:
                 k.startswith(CONFIG_KEY_PREFIX) for k in key_tuple
             )
             if config_pass:
+                # keys=None here even for targeted resyncs: a nudge names
+                # only the head document, but catching up REQUIRES the
+                # _CONFIG_CLUSTER_CS_* rungs; the prefix bounds the sweep.
                 for _ in range(2):
                     await asyncio.gather(
-                        *(pull_peer(info, CONFIG_KEY_PREFIX) for info in peers)
+                        *(pull_peer(info, CONFIG_KEY_PREFIX, None) for info in peers)
                     )
-            # Pass 2: everything (config keys re-apply as no-ops).
-            await asyncio.gather(*(pull_peer(info, None) for info in peers))
+            # Pass 2: the requested keys (config keys re-apply as no-ops).
+            await asyncio.gather(*(pull_peer(info, None, key_tuple) for info in peers))
         if advanced_keys:
             LOG.info("resync advanced %d objects", len(advanced_keys))
             self.metrics.mark("replica.resync-applied", len(advanced_keys))

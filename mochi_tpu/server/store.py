@@ -228,6 +228,9 @@ class DataStore:
         if cached is not None:
             return cached
         sv = self.data_config.get(config_archive_key(cs))
+        if sv is None:
+            # pre-zero-padding archive key form (snapshots / mixed versions)
+            sv = self.data_config.get(f"{CONFIG_ARCHIVE_PREFIX}{cs}")
         if sv is not None and sv.exists and sv.value:
             try:
                 cfg = ClusterConfig.from_json(bytes(sv.value).decode())
@@ -446,6 +449,11 @@ class DataStore:
                     f"non-sequential config: doc cs={doc.configstamp}, "
                     f"ours {current} (want {current} or {current + 1})"
                 )
+            if doc.configstamp == current and not _same_config(doc, self.config):
+                # current-stamp writes are only for idempotent replay: a
+                # DIFFERENT doc at the same stamp is a lost admin race — it
+                # must not overwrite the membership replicas installed.
+                return f"config cs={doc.configstamp} differs from the installed one"
             return None
         try:
             key_stamp = int(op.key[len(CONFIG_ARCHIVE_PREFIX):])
@@ -455,6 +463,9 @@ class DataStore:
             return f"archive {op.key} holds doc cs={doc.configstamp}"
         if doc.configstamp > current + 1:
             return f"archive cs={doc.configstamp} too far ahead of {current}"
+        known = self.config_for_stamp(key_stamp)
+        if known is not None and not _same_config(doc, known):
+            return f"archive {op.key} differs from the known cs={key_stamp} config"
         return None
 
     def process_write2(self, req: Write2ToServer) -> Write2Response:
@@ -467,6 +478,14 @@ class DataStore:
             coalesced, cert_cfg = self._coalesce_grants(req.write_certificate, transaction)
         except BadCertificate as exc:
             return RequestFailedFromServer(FailType.BAD_CERTIFICATE, str(exc))
+
+        # Config-write validation runs as a PRE-PASS so a rejection keeps
+        # the whole transaction un-applied (inside the loop, earlier data
+        # ops would already have committed when a later config op failed).
+        for op in transaction.operations:
+            config_err = self._validate_config_write(op)
+            if config_err is not None:
+                return RequestFailedFromServer(FailType.BAD_REQUEST, config_err)
 
         results: List[OperationResult] = []
         applied: Dict[str, OperationResult] = {}
@@ -495,9 +514,6 @@ class DataStore:
                 return RequestFailedFromServer(
                     FailType.BAD_CERTIFICATE, f"transaction hash mismatch for {op.key}"
                 )
-            config_err = self._validate_config_write(op)
-            if config_err is not None:
-                return RequestFailedFromServer(FailType.BAD_REQUEST, config_err)
             sv = self._get_or_create(op.key)
             current_ts = self._cert_ts(sv)
             if current_ts is not None and current_ts > ts:
@@ -605,6 +621,18 @@ class DataStore:
         sv_after = self._get(entry.key)
         ts_after = self._cert_ts(sv_after) if sv_after else None
         return ts_after is not None and ts_after != ts_before
+
+
+def _same_config(a: ClusterConfig, b: ClusterConfig) -> bool:
+    """Semantic config equality (field-wise; ignores caches)."""
+    return (
+        a.configstamp == b.configstamp
+        and a.rf == b.rf
+        and a.servers == b.servers
+        and a.token_owners == b.token_owners
+        and a.public_keys == b.public_keys
+        and a.admin_keys == b.admin_keys
+    )
 
 
 class BadCertificate(Exception):

@@ -322,8 +322,13 @@ def test_laggard_catches_up_after_two_missed_reconfigs():
             await fresh.start()
             vc.replicas[vc.replicas.index(victim)] = fresh
 
-            await fresh.resync()
+            # the AUTOMATIC path: a targeted config resync (what the
+            # configstamp-ahead nudge schedules) must fetch the archive
+            # rungs even though it names only the head document
+            await fresh.resync(keys=(CONFIG_CLUSTER_KEY,))
             assert fresh.config.configstamp == 3, fresh.config.configstamp
+            # then data follows on a full sweep
+            await fresh.resync()
             sv = fresh.store._get("survivor")
             assert sv is not None and sv.exists
 
