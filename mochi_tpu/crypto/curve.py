@@ -21,7 +21,7 @@ work; the device sees only fixed-shape integer tensors).
 
 Table lookups in the windowed ladder are branchless masked-select sums
 (:func:`select_entry`): data-dependent per-lane gathers don't vectorize on
-the TPU VPU; 16 masked adds do.
+the TPU VPU; 9 masked adds (signed windows) do.
 
 The reference never implements any of this (it never signs — SURVEY.md
 preamble); this is the north-star TPU verifier path of BASELINE.json.
@@ -143,8 +143,9 @@ def decompress(y_limbs: jnp.ndarray, sign: jnp.ndarray) -> Tuple[Point, jnp.ndar
 
 # --------------------------------------------------------------------------
 # Windowed double-scalar-mul: 4-bit digits, msb-first over 64 windows.
-# Per window: 4 doublings, one complete addition from the per-item [0..15]P
-# table, one Niels mixed addition from the constant [0..15]B table (saves
+# Per window: 4 doublings, one complete addition from the per-item [0..8]P
+# table (signed digits), one Niels mixed addition from the constant [0..8]B
+# table (saves
 # 2 muls per addition).  ~3200 field muls/signature.
 
 
@@ -160,10 +161,15 @@ def _py_edwards_add(p, q):
 
 
 def _basepoint_niels_table() -> tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """[d]B for d in 0..15 in Niels form, as three (16, NLIMBS) int32 arrays."""
+    """[d]B for d in 0..8 in Niels form, as three (9, NLIMBS) int32 arrays.
+
+    9 entries, not 16: the ladder uses SIGNED 4-bit windows (digits in
+    [-8, 8]) — negative digits reuse entry |d| with the cheap Niels
+    negation (swap y+x / y-x, negate xy2d).
+    """
     b = (F.BX_INT, F.BY_INT)
     pts = [(0, 1)]  # identity
-    for _ in range(15):
+    for _ in range(8):
         pts.append(_py_edwards_add(pts[-1], b))
     ypx = np.stack([F.int_to_limbs((y + x) % F.P_INT) for x, y in pts])
     ymx = np.stack([F.int_to_limbs((y - x) % F.P_INT) for x, y in pts])
@@ -202,6 +208,29 @@ def digits4_from_bits(bits: jnp.ndarray) -> jnp.ndarray:
     return (bits.reshape(64, 4, *lanes) * w).sum(axis=1).astype(jnp.int32)
 
 
+def recode_signed4(dig: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Base-16 digits [0,15] -> signed digits: (magnitude [0,8], neg flag).
+
+    Exact carry recode: d + c_in = d' + 16*c_out with d' in [-7, 8]
+    (t = d + c_in in [0, 16]; t > 8 maps to t - 16 in [-7, 0] with carry).
+    The final window of an Ed25519 scalar < 2^253 is <= 1, so the last
+    carry never overflows.  Signed windows HALVE the per-item table — the VMEM
+    limiter that capped throughput at batch 4096 — and cut the masked
+    lookup from 16 to 9 terms.
+    """
+    mags, negs = [], []
+    c = jnp.zeros(dig.shape[1:], dtype=jnp.int32)
+    for k in range(dig.shape[0]):
+        t = dig[k] + c
+        carry = (t > 8).astype(jnp.int32)
+        d = t - 16 * carry  # in [-8, 8]
+        c = carry
+        neg = d < 0
+        mags.append(jnp.where(neg, -d, d))
+        negs.append(neg)
+    return jnp.stack(mags, axis=0), jnp.stack(negs, axis=0)
+
+
 def select_entry(table, idx: jnp.ndarray, n_entries: int):
     """Branchless per-lane table lookup: sum of masked entries.
 
@@ -219,25 +248,28 @@ def select_entry(table, idx: jnp.ndarray, n_entries: int):
     return tuple(out)
 
 
+N_TABLE = 9  # [0..8]P — signed 4-bit windows need magnitudes 0..8 only
+
+
 def _small_multiples_table(p: Point):
-    """[0..15]P stacked on axis 0 — built by 15 chained additions inside ONE
-    fori_loop body (vs 14 unrolled point ops: ~10x smaller traced graph).
+    """[0..8]P stacked on axis 0 — built by 8 chained additions inside ONE
+    fori_loop body (vs unrolled point ops: much smaller traced graph).
 
     Mosaic-safe mode unrolls the chain and stacks at the end (no dynamic
-    updates); the extra ~135 traced muls are acceptable inside the kernel.
+    updates); the extra ~70 traced muls are acceptable inside the kernel.
     """
     lanes = p.x.shape[1:]
     ident = identity(lanes)
     if MOSAIC_SAFE:
         pts = [ident]
-        for _ in range(15):
+        for _ in range(N_TABLE - 1):
             pts.append(add(pts[-1], p))
         return tuple(
             jnp.stack([getattr(pt, c) for pt in pts], axis=0)
             for c in ("x", "y", "z", "t")
         )
     table = tuple(
-        jnp.zeros((16, F.NLIMBS, *lanes), jnp.int32).at[0].set(c) for c in ident
+        jnp.zeros((N_TABLE, F.NLIMBS, *lanes), jnp.int32).at[0].set(c) for c in ident
     )
 
     def chain(k, carry):
@@ -249,7 +281,7 @@ def _small_multiples_table(p: Point):
         )
         return (table, tuple(cur))
 
-    table, _ = lax.fori_loop(1, 16, chain, (table, tuple(ident)))
+    table, _ = lax.fori_loop(1, N_TABLE, chain, (table, tuple(ident)))
     return table
 
 
@@ -258,13 +290,16 @@ def double_scalar_mul_windowed(
 ) -> Point:
     """[s]B + [p]P with 4-bit windows, msb-first over 64 windows.
 
-    ``s_dig``/``p_dig``: (64, lanes) base-16 digits (little-endian windows).
+    ``s_dig``/``p_dig``: (64, lanes) base-16 digits (little-endian windows)
+    — recoded internally to signed digits (:func:`recode_signed4`).
     ``b_tab``: optional externally-supplied Niels basepoint tables — three
-    (16, 17[, 1]) arrays.  Pallas kernels pass them as operands (Mosaic
+    (9, 17[, 1]) arrays.  Pallas kernels pass them as operands (Mosaic
     rejects closure-captured array constants); the XLA path leaves this None
     and embeds them as literals.
     """
     lanes = s_dig.shape[1:]
+    s_mag, s_neg = recode_signed4(s_dig)
+    p_mag, p_neg = recode_signed4(p_dig)
     a_tab = _small_multiples_table(p_point)
     if b_tab is None:
         b_tab = (
@@ -289,9 +324,21 @@ def double_scalar_mul_windowed(
     def body(i, q):
         w = 63 - i
         q = double(double(double(double(Point(*q)))))
-        entry = Point(*select_entry(a_tab, digit_at(p_dig, w), 16))
+        ex, ey, ez, et = select_entry(a_tab, digit_at(p_mag, w), N_TABLE)
+        pn = digit_at(p_neg.astype(jnp.int32), w).astype(bool)
+        # negative digit: -(x, y, z, t) = (-x, y, z, -t), branchless
+        entry = Point(
+            F.select(pn, F.neg(ex), ex), ey, ez, F.select(pn, F.neg(et), et)
+        )
         q = add(q, entry)
-        nypx, nymx, nxy2d = select_entry(b_tab, digit_at(s_dig, w), 16)
+        nypx, nymx, nxy2d = select_entry(b_tab, digit_at(s_mag, w), N_TABLE)
+        sn = digit_at(s_neg.astype(jnp.int32), w).astype(bool)
+        # Niels negation: swap (y+x)/(y-x), negate xy2d
+        nypx, nymx = (
+            F.select(sn, nymx, nypx),
+            F.select(sn, nypx, nymx),
+        )
+        nxy2d = F.select(sn, F.neg(nxy2d), nxy2d)
         return tuple(madd_niels(q, nypx, nymx, nxy2d))
 
     q = lax.fori_loop(0, 64, body, tuple(identity(lanes)))

@@ -124,8 +124,11 @@ def verify_prepared_pallas(
     )
     dig_spec = pl.BlockSpec((64, block), lambda i: (0, i), memory_space=pltpu.VMEM)
     sign_spec = pl.BlockSpec((1, block), lambda i: (0, i), memory_space=pltpu.VMEM)
-    # Niels basepoint tables: same (16, 17) block for every grid program.
-    tab_spec = pl.BlockSpec((16, F.NLIMBS), lambda i: (0, 0), memory_space=pltpu.VMEM)
+    # Niels basepoint tables: same (9, 17) block for every grid program
+    # (signed 4-bit windows, curve.N_TABLE entries).
+    tab_spec = pl.BlockSpec(
+        (curve.N_TABLE, F.NLIMBS), lambda i: (0, 0), memory_space=pltpu.VMEM
+    )
     out = pl.pallas_call(
         _kernel,
         out_shape=jax.ShapeDtypeStruct((1, m), jnp.int32),
