@@ -74,7 +74,10 @@ def _measure() -> dict:
 
     # 4096 is the VMEM-residency peak (batch_verify.MAX_BUCKET); 8192/16384
     # document the spill regression the production path avoids by chunking.
-    batches = (1024, 2048, 4096, 8192, 16384)
+    # The CPU-backend fallback (TPU unreachable) keeps batches small: XLA-CPU
+    # runs ~75x slower per signature and the full sweep would blow the
+    # fallback's time budget for a number that is only a loud failure flag.
+    batches = (1024, 2048, 4096, 8192, 16384) if dev.platform == "tpu" else (256, 1024)
     impls = {}
 
     # ---- XLA path -------------------------------------------------------

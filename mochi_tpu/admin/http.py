@@ -86,6 +86,10 @@ class AdminServer:
                     },
                     "store": r.store.stats(),
                     "verifier": type(r.verifier).__name__ if r.verifier else "CpuVerifier",
+                    "sessions": len(getattr(r, "_sessions", {})),
+                    "config_history_stamps": sorted(r.store.config_history),
+                    "member": r.server_id in cfg.servers,
+                    "admin_gated": bool(cfg.admin_keys),
                 }
             )
         if path == "/metrics":

@@ -56,7 +56,12 @@ async def amain(args) -> None:
         host, _, port = target.rpartition(":")
         if not host or not port.isdigit():
             raise SystemExit(f"--verifier remote:<host>:<port> (got {args.verifier!r})")
-        verifier = RemoteVerifier(host, int(port))
+        secret = None
+        if args.verifier_secret_file:
+            from ..verifier.service import load_secret
+
+            secret = load_secret(args.verifier_secret_file)
+        verifier = RemoteVerifier(host, int(port), secret=secret)
     elif args.verifier != "cpu":
         # No silent fallback: a typo'd --verifier must not quietly run the
         # inline CPU path (the misconfiguration argparse choices= used to
@@ -113,6 +118,12 @@ def main(argv=None) -> None:
         "--verifier",
         default="cpu",
         help="cpu | tpu | remote:<host>:<port> (shared verifier service)",
+    )
+    parser.add_argument(
+        "--verifier-secret-file",
+        default=None,
+        help="hex shared secret MAC-authenticating the remote verifier RPC "
+        "(must match the service's --secret-file)",
     )
     parser.add_argument(
         "--admin-port",

@@ -22,7 +22,16 @@ to the service and falls back to local CPU verification if the service is
 unreachable (availability degrades to the reference-analog path; safety —
 never skip a check — is preserved).
 
-Run:  ``python -m mochi_tpu.verifier.service --port 18200``
+Trust model: the verify RPC carries VERDICTS — a forged response saying
+"all valid" would admit forged grants — so the channel must be
+authenticated.  Two supported postures: (1) loopback-only (the default
+bind; the OS is the trust boundary), or (2) a shared secret
+(``--secret-file`` / ``secret=``): both directions MAC every envelope with
+HMAC-SHA256 over the canonical envelope bytes.  A service with a secret
+rejects unMAC'd requests; a client with a secret rejects unMAC'd responses
+(falling back to LOCAL CPU verification, never to trusting the network).
+
+Run:  ``python -m mochi_tpu.verifier.service --port 18200 [--secret-file f]``
 Wire: ``python -m mochi_tpu.server ... --verifier remote:127.0.0.1:18200``
 """
 
@@ -32,9 +41,11 @@ import argparse
 import asyncio
 import logging
 import time
+from pathlib import Path
 from typing import List, Optional, Sequence
 
 from ..cluster.config import ServerInfo
+from ..crypto import session as session_crypto
 from ..net.transport import RpcServer, _Connection, new_msg_id
 from ..protocol import (
     Envelope,
@@ -56,6 +67,17 @@ LOG = logging.getLogger(__name__)
 SERVICE_ID = "verifier-service"
 
 
+def load_secret(path: str) -> bytes:
+    """Load a hex shared secret; refuse degenerate keys (an empty file would
+    silently 'authenticate' with HMAC key b'' that anyone can compute)."""
+    secret = bytes.fromhex(Path(path).read_text().strip())
+    if len(secret) < 16:
+        raise SystemExit(
+            f"verifier secret in {path} is {len(secret)} bytes; need >= 16"
+        )
+    return secret
+
+
 class VerifierService:
     """TPU-owning verification service shared by all replica processes."""
 
@@ -66,7 +88,9 @@ class VerifierService:
         verifier: Optional[SignatureVerifier] = None,
         max_items_per_request: int = 65536,
         cache: bool = True,
+        secret: Optional[bytes] = None,
     ):
+        self.secret = secret
         if verifier is None:
             from .tpu import TpuBatchVerifier
 
@@ -96,16 +120,28 @@ class VerifierService:
     async def _handle(self, env: Envelope) -> Optional[Envelope]:
         def fail(ft: FailType, detail: str) -> Envelope:
             # Fail FAST with a typed error — a silent drop would park the
-            # requesting replica for its full RPC timeout.
-            return Envelope(
+            # requesting replica for its full RPC timeout.  MAC'd like the
+            # success path so a secret-holding client sees the real reason
+            # instead of misreporting it as a response-MAC failure.
+            resp = Envelope(
                 RequestFailedFromServer(ft, detail),
                 msg_id=new_msg_id(),
                 sender_id=SERVICE_ID,
                 reply_to=env.msg_id,
             )
+            if self.secret is not None:
+                resp = resp.with_mac(
+                    session_crypto.mac(self.secret, resp.signing_bytes())
+                )
+            return resp
 
         if not isinstance(env.payload, VerifyRequestToServer):
             return fail(FailType.BAD_REQUEST, "expected VerifyRequestToServer")
+        if self.secret is not None and not (
+            env.mac is not None
+            and session_crypto.mac_ok(self.secret, env.signing_bytes(), env.mac)
+        ):
+            return fail(FailType.BAD_SIGNATURE, "verify request MAC missing/invalid")
         items = env.payload.items
         if len(items) > self.max_items_per_request:
             return fail(
@@ -117,12 +153,15 @@ class VerifierService:
         )
         self.requests += 1
         self.items += len(items)
-        return Envelope(
+        resp = Envelope(
             VerifyBitmapFromServer(tuple(bitmap)),
             msg_id=new_msg_id(),
             sender_id=SERVICE_ID,
             reply_to=env.msg_id,
         )
+        if self.secret is not None:
+            resp = resp.with_mac(session_crypto.mac(self.secret, resp.signing_bytes()))
+        return resp
 
 
 class RemoteVerifier(SignatureVerifier):
@@ -144,10 +183,12 @@ class RemoteVerifier(SignatureVerifier):
         port: int,
         timeout_s: float = 30.0,
         fallback: Optional[SignatureVerifier] = None,
+        secret: Optional[bytes] = None,
     ):
         self._conn = _Connection(ServerInfo("verifier", host, port))
         self.timeout_s = timeout_s
         self.fallback = fallback if fallback is not None else CpuVerifier()
+        self.secret = secret
         self.remote_batches = 0
         self.fallback_batches = 0
 
@@ -166,8 +207,17 @@ class RemoteVerifier(SignatureVerifier):
             msg_id=new_msg_id(),
             sender_id="verifier-client",
         )
+        if self.secret is not None:
+            req = req.with_mac(session_crypto.mac(self.secret, req.signing_bytes()))
         try:
             resp = await self._conn.send_and_receive(req, self.timeout_s)
+            if self.secret is not None and not (
+                resp.mac is not None
+                and session_crypto.mac_ok(self.secret, resp.signing_bytes(), resp.mac)
+            ):
+                # forged/unauthenticated verdicts NEVER pass through — the
+                # fallback below re-verifies locally instead
+                raise ValueError("verifier response MAC missing/invalid")
             payload = resp.payload
             if (
                 not isinstance(payload, VerifyBitmapFromServer)
@@ -198,7 +248,12 @@ async def amain(args) -> None:
             warmup_buckets=tuple(int(b) for b in args.warmup.split(",") if b)
         )
         LOG.info("device warmup took %.1fs", time.time() - t0)
-    service = VerifierService(host=args.host, port=args.port, verifier=verifier)
+    secret = None
+    if args.secret_file:
+        secret = load_secret(args.secret_file)
+    service = VerifierService(
+        host=args.host, port=args.port, verifier=verifier, secret=secret
+    )
     await service.start()
     print(f"READY {SERVICE_ID} {service.bound_port}", flush=True)
     try:
@@ -216,6 +271,12 @@ def main(argv=None) -> None:
         "--warmup",
         default="16,256",
         help="comma-separated bucket sizes to pre-compile at boot",
+    )
+    parser.add_argument(
+        "--secret-file",
+        default=None,
+        help="hex shared secret: MAC-authenticate the verify RPC in both "
+        "directions (required when the service is not loopback-only)",
     )
     parser.add_argument("--log-level", default="INFO")
     args = parser.parse_args(argv)

@@ -26,9 +26,18 @@ PIDS=()
 # way a multi-process cluster gets TPU-backed verification).  Other values
 # (cpu | tpu | remote:<host>:<port>) pass through per replica.
 VERIFIER="${MOCHI_VERIFIER:-cpu}"
+SECRET_ARGS=()
 if [ "$VERIFIER" = "remote" ]; then
   VPORT=$((BASE_PORT + 2000))
+  # Shared secret authenticating the verify RPC both ways (the responses
+  # are verdicts; see verifier/service.py trust model).
+  if [ ! -f "$OUT/verifier.secret" ]; then
+    (umask 077 && python -c "import os; print(os.urandom(32).hex())" > "$OUT/verifier.secret")
+  fi
+  chmod 600 "$OUT/verifier.secret"
   python -m mochi_tpu.verifier.service --port "$VPORT" \
+    --backend "${MOCHI_VERIFIER_BACKEND:-tpu}" \
+    --secret-file "$OUT/verifier.secret" \
     >"$OUT/log/verifier.log" 2>&1 &
   PIDS+=($!)
   for _ in $(seq 1 120); do
@@ -36,6 +45,7 @@ if [ "$VERIFIER" = "remote" ]; then
     sleep 1
   done
   VERIFIER="remote:127.0.0.1:$VPORT"
+  SECRET_ARGS=(--verifier-secret-file "$OUT/verifier.secret")
 fi
 
 for i in $(seq 0 $((N - 1))); do
@@ -45,6 +55,7 @@ for i in $(seq 0 $((N - 1))); do
     --seed-file "$OUT/server-$i.seed" \
     --admin-port $((BASE_PORT + 1000 + i)) \
     --verifier "$VERIFIER" \
+    ${SECRET_ARGS[@]+"${SECRET_ARGS[@]}"} \
     >"$OUT/log/server-$i.log" 2>&1 &
   PIDS+=($!)
 done

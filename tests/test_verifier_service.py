@@ -64,6 +64,42 @@ def test_remote_verifier_falls_back_when_service_down():
     run(main())
 
 
+def test_shared_secret_authenticates_both_directions():
+    async def main():
+        secret = bytes(range(32))
+        service = VerifierService(port=0, verifier=CpuVerifier(), secret=secret)
+        await service.start()
+        try:
+            # matching secret: verdicts flow
+            rv = RemoteVerifier("127.0.0.1", service.bound_port, secret=secret)
+            bitmap = await rv.verify_batch(make_items(4, forge={1}))
+            assert bitmap == [True, False, True, True]
+            assert rv.remote_batches == 1 and rv.fallback_batches == 0
+            await rv.close()
+
+            # client without the secret: request rejected fast, local
+            # fallback still verifies correctly (never trusts the network)
+            rv2 = RemoteVerifier("127.0.0.1", service.bound_port, timeout_s=5.0)
+            bitmap = await rv2.verify_batch(make_items(4, forge={2}))
+            assert bitmap == [True, True, False, True]
+            assert rv2.fallback_batches == 1
+            await rv2.close()
+
+            # client with a WRONG secret: its own MAC check rejects the
+            # response path symmetrically -> fallback
+            rv3 = RemoteVerifier(
+                "127.0.0.1", service.bound_port, timeout_s=5.0, secret=bytes(32)
+            )
+            bitmap = await rv3.verify_batch(make_items(3))
+            assert bitmap == [True, True, True]
+            assert rv3.fallback_batches == 1
+            await rv3.close()
+        finally:
+            await service.close()
+
+    run(main())
+
+
 def test_cluster_routes_cert_checks_through_shared_service():
     async def main():
         service = VerifierService(port=0, verifier=CpuVerifier())
