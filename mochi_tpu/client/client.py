@@ -26,10 +26,12 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..cluster.config import (
+    CONFIG_CLIENT_PREFIX,
     CONFIG_CLUSTER_KEY,
     ClusterConfig,
     ServerInfo,
     config_archive_key,
+    config_client_key,
 )
 from ..crypto import session as session_crypto
 from ..crypto.keys import KeyPair, generate_keypair, verify as cpu_verify
@@ -100,7 +102,20 @@ class MochiDBClient:
     @staticmethod
     def _is_admin_txn(transaction: Transaction) -> bool:
         return any(
-            op.key.startswith(CONFIG_CLUSTER_KEY) for op in transaction.operations
+            op.key.startswith(CONFIG_CLUSTER_KEY)
+            or op.key.startswith(CONFIG_CLIENT_PREFIX)
+            for op in transaction.operations
+        )
+
+    async def register_client_key(self, client_id: str, public_key: bytes) -> None:
+        """Admin: durably register a client's Ed25519 key so replicas with
+        ``require_client_auth`` accept it (``_CONFIG_CLIENT_<id>``)."""
+        if len(public_key) != 32:
+            raise ValueError("Ed25519 public key must be 32 bytes")
+        await self.execute_write_transaction(
+            Transaction(
+                (Operation(Action.WRITE, config_client_key(client_id), public_key),)
+            )
         )
 
     @classmethod

@@ -55,6 +55,18 @@ def config_archive_key(configstamp: int) -> str:
     return f"{CONFIG_ARCHIVE_PREFIX}{configstamp:010d}"
 
 
+# Durable client key registry ("_CONFIG_CLIENT_<client_id>" -> 32-byte
+# Ed25519 pubkey), committed through the normal write path and — like the
+# membership document — admin-gated when config.admin_keys is set.  This is
+# what makes --require-client-auth deployable: replicas resolve unknown
+# senders against the registry.
+CONFIG_CLIENT_PREFIX = CONFIG_KEY_PREFIX + "CLIENT_"
+
+
+def config_client_key(client_id: str) -> str:
+    return f"{CONFIG_CLIENT_PREFIX}{client_id}"
+
+
 @dataclass(frozen=True)
 class ServerInfo:
     """Addressable replica endpoint (ref: ``server/messaging/Server.java``)."""
@@ -318,7 +330,18 @@ class ClusterConfig:
             for sid in server_ids
             if f"_CONFIG_SERVER_{sid}_PUBKEY" in props
         }
-        cfg = cls(servers=servers, token_owners=token_owners, rf=rf, public_keys=pubkeys)
+        admin_keys = [
+            bytes.fromhex(h)
+            for h in props.get("_CONFIG_ADMIN_KEYS", "").split(",")
+            if h
+        ]
+        cfg = cls(
+            servers=servers,
+            token_owners=token_owners,
+            rf=rf,
+            public_keys=pubkeys,
+            admin_keys=admin_keys,
+        )
         cfg.validate()
         return cfg
 
@@ -339,6 +362,10 @@ class ClusterConfig:
             )
             if sid in self.public_keys:
                 lines.append(f"_CONFIG_SERVER_{sid}_PUBKEY={self.public_keys[sid].hex()}")
+        if self.admin_keys:
+            lines.append(
+                "_CONFIG_ADMIN_KEYS=" + ",".join(pk.hex() for pk in self.admin_keys)
+            )
         return "\n".join(lines) + "\n"
 
     @classmethod

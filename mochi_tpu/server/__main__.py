@@ -30,6 +30,14 @@ def load_config(path: str) -> ClusterConfig:
 
 async def amain(args) -> None:
     config = load_config(args.config)
+    if args.require_client_auth and not config.admin_keys:
+        # Unrecoverable lockout otherwise: every client is unknown, and
+        # registering one requires an authenticated write, which requires
+        # being registered — only an admin key breaks the cycle.
+        raise SystemExit(
+            "--require-client-auth needs config.admin_keys to bootstrap the "
+            "client registry (generate with gen_cluster --with-admin)"
+        )
     keypair = keypair_from_seed(bytes.fromhex(Path(args.seed_file).read_text().strip()))
     if keypair.public_key != config.public_keys.get(args.server_id):
         raise SystemExit(
@@ -77,6 +85,7 @@ async def amain(args) -> None:
         config=config,
         keypair=keypair,
         verifier=verifier,
+        require_client_auth=args.require_client_auth,
         host=args.host or info.host,
         port=info.port,
         snapshot_path=snapshot_path,
@@ -152,6 +161,14 @@ def main(argv=None) -> None:
         "--resync-on-boot",
         action="store_true",
         help="pull committed state from peers before serving (UptoSpeed)",
+    )
+    parser.add_argument(
+        "--require-client-auth",
+        action="store_true",
+        help="reject envelopes from clients with no registered key "
+        "(register via the _CONFIG_CLIENT_<id> keyspace, "
+        "MochiDBClient.register_client_key; admin-gated when "
+        "config.admin_keys is set)",
     )
     parser.add_argument("--log-level", default="INFO")
     args = parser.parse_args(argv)

@@ -24,7 +24,12 @@ import time
 from dataclasses import replace
 from typing import Dict, Iterable, Optional
 
-from ..cluster.config import CONFIG_CLUSTER_KEY, ClusterConfig
+from ..cluster.config import (
+    CONFIG_CLIENT_PREFIX,
+    CONFIG_CLUSTER_KEY,
+    ClusterConfig,
+    config_client_key,
+)
 from ..crypto import session as session_crypto
 from ..crypto.keys import KeyPair, verify as crypto_verify
 from ..net.transport import RpcClientPool, RpcServer, new_msg_id
@@ -96,6 +101,9 @@ class MochiReplica:
         # Reconfiguration (paper mochiDB.tex:184-199): a committed write to
         # CONFIG_CLUSTER_KEY installs the new membership live.
         self.store.on_config_value = self._install_config
+        # Registry rotation/revocation invalidates the client's live MAC
+        # session — the next envelope re-authenticates against the new key.
+        self.store.on_client_key_change = lambda cid: self._sessions.pop(cid, None)
 
     # ----------------------------------------------------------------- boot
 
@@ -225,6 +233,13 @@ class MochiReplica:
         key = self.config.public_keys.get(sender_id)
         if key is None:
             key = self.client_public_keys.get(sender_id)
+        if key is None:
+            # durable registry: _CONFIG_CLIENT_<id> committed via the
+            # (admin-gated) config keyspace
+            sv = self.store.data_config.get(config_client_key(sender_id))
+            if sv is not None and sv.exists and isinstance(sv.value, (bytes, bytearray)):
+                if len(sv.value) == 32:
+                    key = bytes(sv.value)
         return key
 
     async def _authenticate(self, env: Envelope) -> bool:
@@ -251,7 +266,9 @@ class MochiReplica:
     def _is_admin_op(payload) -> bool:
         txn = getattr(payload, "transaction", None)
         return txn is not None and any(
-            op.key.startswith(CONFIG_CLUSTER_KEY) for op in txn.operations
+            op.key.startswith(CONFIG_CLUSTER_KEY)
+            or op.key.startswith(CONFIG_CLIENT_PREFIX)
+            for op in txn.operations
         )
 
     def _admin_sig_ok(self, env: Envelope) -> bool:

@@ -36,6 +36,7 @@ from typing import Dict, Iterable, List, Optional, Tuple, Union
 
 from ..cluster.config import (
     CONFIG_ARCHIVE_PREFIX,
+    CONFIG_CLIENT_PREFIX,
     CONFIG_CLUSTER_KEY,
     CONFIG_KEY_PREFIX,
     ClusterConfig,
@@ -180,6 +181,10 @@ class DataStore:
         # CONFIG_CLUSTER_KEY — the replica installs the new membership
         # (paper's configuration change, mochiDB.tex:184-199).
         self.on_config_value = None  # Optional[Callable[[bytes], None]]
+        # Fired when a client registry entry (_CONFIG_CLIENT_<id>) changes:
+        # the replica must drop any live session for that client, else a
+        # revoked/rotated key keeps transacting through its old MAC session.
+        self.on_client_key_change = None  # Optional[Callable[[str], None]]
         # configstamp -> config, for validating certificates formed under
         # PREVIOUS configurations (resync replays them; their quorum shape
         # is the one they were granted under).  Live replicas accumulate
@@ -488,13 +493,10 @@ class DataStore:
                 return RequestFailedFromServer(FailType.BAD_REQUEST, config_err)
 
         results: List[OperationResult] = []
-        applied: Dict[str, OperationResult] = {}
+        staleness_checked: Dict[str, bool] = {}
         for op in transaction.operations:
             if not self.owns(op.key):
                 results.append(OperationResult(status=Status.WRONG_SHARD))
-                continue
-            if op.key in applied:
-                results.append(applied[op.key])
                 continue
             entry = coalesced.get(op.key)
             if entry is None:
@@ -515,14 +517,23 @@ class DataStore:
                     FailType.BAD_CERTIFICATE, f"transaction hash mismatch for {op.key}"
                 )
             sv = self._get_or_create(op.key)
-            current_ts = self._cert_ts(sv)
-            if current_ts is not None and current_ts > ts:
+            # Duplicate keys apply SEQUENTIALLY (last write wins), matching
+            # the reference's per-op applyOperation loop
+            # (InMemoryDataStore.java:521-554).  The staleness verdict is
+            # made once per key — after the first apply the key's
+            # certificate IS this transaction's, and re-deciding against it
+            # would misclassify the second op.
+            stale = staleness_checked.get(op.key)
+            if stale is None:
+                current_ts = self._cert_ts(sv)
+                stale = current_ts is not None and current_ts > ts
+                staleness_checked[op.key] = stale
+            if stale:
                 # Stale write2: answer with current state instead
                 # (ref: InMemoryDataStore.java:594-598).
                 result = OperationResult(sv.value, sv.current_certificate, sv.exists, Status.OK)
             else:
                 result = self._apply(op, sv, ts, req.write_certificate, transaction)
-            applied[op.key] = result
             results.append(result)
         return Write2AnsFromServer(TransactionResult(tuple(results)), rid="")
 
@@ -564,6 +575,11 @@ class DataStore:
                 self.on_config_value(op.value)
             except Exception:
                 LOG.exception("config install hook failed")
+        if op.key.startswith(CONFIG_CLIENT_PREFIX) and self.on_client_key_change:
+            try:
+                self.on_client_key_change(op.key[len(CONFIG_CLIENT_PREFIX):])
+            except Exception:
+                LOG.exception("client key change hook failed")
         return OperationResult(op.value, wc, existed_before, Status.OK)
 
     # ----------------------------------------------------------------- sync

@@ -30,6 +30,14 @@ def main(argv=None) -> None:
     parser.add_argument("--base-port", type=int, default=8001)
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--format", choices=("json", "properties"), default="json")
+    parser.add_argument(
+        "--with-admin",
+        action="store_true",
+        help="also generate an admin keypair (admin.seed) and pin its public "
+        "key in config.admin_keys — required for the secure posture "
+        "(reconfiguration + client-registry writes become admin-gated; "
+        "pairs with the server's --require-client-auth)",
+    )
     args = parser.parse_args(argv)
 
     out = Path(args.out_dir)
@@ -42,6 +50,12 @@ def main(argv=None) -> None:
         rf=args.rf,
         public_keys={sid: kp.public_key for sid, kp in keypairs.items()},
     )
+    if args.with_admin:
+        admin = generate_keypair()
+        config.admin_keys.append(admin.public_key)
+        admin_path = out / "admin.seed"
+        admin_path.write_text(admin.private_seed.hex())
+        os.chmod(admin_path, 0o600)
 
     if args.format == "json":
         path = out / "cluster_config.json"

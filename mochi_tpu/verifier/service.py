@@ -67,6 +67,14 @@ LOG = logging.getLogger(__name__)
 SERVICE_ID = "verifier-service"
 
 
+def _seal(env: Envelope, secret: Optional[bytes]) -> Envelope:
+    """Attach the shared-secret MAC (no-op without a secret) — the single
+    place the sealing scheme lives for requests, responses and failures."""
+    if secret is None:
+        return env
+    return env.with_mac(session_crypto.mac(secret, env.signing_bytes()))
+
+
 def load_secret(path: str) -> bytes:
     """Load a hex shared secret; refuse degenerate keys (an empty file would
     silently 'authenticate' with HMAC key b'' that anyone can compute)."""
@@ -120,20 +128,18 @@ class VerifierService:
     async def _handle(self, env: Envelope) -> Optional[Envelope]:
         def fail(ft: FailType, detail: str) -> Envelope:
             # Fail FAST with a typed error — a silent drop would park the
-            # requesting replica for its full RPC timeout.  MAC'd like the
+            # requesting replica for its full RPC timeout.  Sealed like the
             # success path so a secret-holding client sees the real reason
             # instead of misreporting it as a response-MAC failure.
-            resp = Envelope(
-                RequestFailedFromServer(ft, detail),
-                msg_id=new_msg_id(),
-                sender_id=SERVICE_ID,
-                reply_to=env.msg_id,
+            return _seal(
+                Envelope(
+                    RequestFailedFromServer(ft, detail),
+                    msg_id=new_msg_id(),
+                    sender_id=SERVICE_ID,
+                    reply_to=env.msg_id,
+                ),
+                self.secret,
             )
-            if self.secret is not None:
-                resp = resp.with_mac(
-                    session_crypto.mac(self.secret, resp.signing_bytes())
-                )
-            return resp
 
         if not isinstance(env.payload, VerifyRequestToServer):
             return fail(FailType.BAD_REQUEST, "expected VerifyRequestToServer")
@@ -153,15 +159,15 @@ class VerifierService:
         )
         self.requests += 1
         self.items += len(items)
-        resp = Envelope(
-            VerifyBitmapFromServer(tuple(bitmap)),
-            msg_id=new_msg_id(),
-            sender_id=SERVICE_ID,
-            reply_to=env.msg_id,
+        return _seal(
+            Envelope(
+                VerifyBitmapFromServer(tuple(bitmap)),
+                msg_id=new_msg_id(),
+                sender_id=SERVICE_ID,
+                reply_to=env.msg_id,
+            ),
+            self.secret,
         )
-        if self.secret is not None:
-            resp = resp.with_mac(session_crypto.mac(self.secret, resp.signing_bytes()))
-        return resp
 
 
 class RemoteVerifier(SignatureVerifier):
@@ -207,8 +213,7 @@ class RemoteVerifier(SignatureVerifier):
             msg_id=new_msg_id(),
             sender_id="verifier-client",
         )
-        if self.secret is not None:
-            req = req.with_mac(session_crypto.mac(self.secret, req.signing_bytes()))
+        req = _seal(req, self.secret)
         try:
             resp = await self._conn.send_and_receive(req, self.timeout_s)
             if self.secret is not None and not (

@@ -370,6 +370,50 @@ def test_non_sequential_config_write_rejected():
     assert "non-sequential" in resp.detail
 
 
+def test_admin_gating_covers_client_registry():
+    """_CONFIG_CLIENT_* writes are admin-gated when admin_keys is set: an
+    ordinary registered client must NOT be able to overwrite another
+    client's key binding (impersonation), while the admin key can — and a
+    registry rotation drops the victim's live MAC session."""
+
+    async def main():
+        async with VirtualCluster(4, rf=4) as vc:
+            admin = vc.client()
+            rogue = vc.client()
+            victim = vc.client()
+            # establish victim sessions, then lock the config keyspace
+            await victim.execute_write_transaction(
+                TransactionBuilder().write("v", b"1").build()
+            )
+            vc.config.admin_keys.append(admin.keypair.public_key)
+
+            try:
+                await rogue.register_client_key(victim.client_id, bytes(32))
+                raise AssertionError("non-admin registry write should fail")
+            except AssertionError:
+                raise
+            except Exception:
+                pass
+
+            assert victim.client_id in vc.replicas[0]._sessions
+            await admin.register_client_key(
+                victim.client_id, victim.keypair.public_key
+            )
+            # rotation hook: victim's sessions were dropped on every replica
+            for r in vc.replicas:
+                assert victim.client_id not in r._sessions
+            # and the victim transparently re-handshakes
+            await victim.execute_write_transaction(
+                TransactionBuilder().write("v", b"2").build()
+            )
+            res = await victim.execute_read_transaction(
+                TransactionBuilder().read("v").build()
+            )
+            assert res.operations[0].value == b"2"
+
+    run(main())
+
+
 def test_evolve_carries_keys_and_bumps_stamp():
     kp = generate_keypair()
     cfg = ClusterConfig.build(
