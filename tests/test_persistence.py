@@ -10,6 +10,7 @@ import os
 import pytest
 
 from mochi_tpu.client import TransactionBuilder
+from mochi_tpu.cluster.config import ClusterConfig
 from mochi_tpu.server import persistence
 from mochi_tpu.server.replica import MochiReplica
 from mochi_tpu.server.store import DataStore
@@ -88,8 +89,49 @@ def test_corrupt_snapshot_rejected(tmp_path):
 
 
 def _tiny_config():
-    from mochi_tpu.cluster.config import ClusterConfig
-
     return ClusterConfig.build(
         {f"server-{i}": f"127.0.0.1:{9000+i}" for i in range(4)}, rf=4
     )
+
+
+def test_boot_installs_newer_config_from_snapshot(tmp_path):
+    """A snapshot taken AFTER a reconfiguration holds the cs=2 membership;
+    a replica booting from it with the old cs=1 config file must install
+    the snapshot's config before serving (replica.start path)."""
+
+    async def main():
+        async with VirtualCluster(4, rf=4) as vc:
+            client = vc.client()
+            await client.execute_write_transaction(
+                TransactionBuilder().write("bk", b"v").build()
+            )
+            old_config = vc.config
+            urls = {sid: info.url for sid, info in vc.config.servers.items()}
+            await client.reconfigure_cluster(vc.config.evolve(urls))
+
+            donor = vc.replicas[0]
+            assert donor.config.configstamp == 2
+            path = str(tmp_path / "snap")
+            persistence.write_snapshot(donor.store, path)
+
+            # boot a replica from the snapshot but with the STALE config
+            stale = ClusterConfig.from_json(old_config.to_json())
+            stale.configstamp = 1
+            fresh = MochiReplica(
+                server_id=donor.server_id,
+                config=stale,
+                keypair=vc.keypairs[donor.server_id],
+                host="127.0.0.1",
+                port=0,
+                snapshot_path=path,
+            )
+            await fresh.start()
+            try:
+                assert fresh.config.configstamp == 2, fresh.config.configstamp
+                assert fresh.store.config.configstamp == 2
+                sv = fresh.store._get("bk")
+                assert sv is not None and sv.exists
+            finally:
+                await fresh.close()
+
+    run(main())
