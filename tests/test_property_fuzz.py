@@ -1,0 +1,153 @@
+"""Property-based fuzzing (hypothesis): codec, envelope, signed-digit recode.
+
+The mcode codec is the trust root of the whole signature scheme (wire bytes
+== signing bytes), so its invariants get generative coverage beyond the
+hand-picked cases in test_codec.py:
+
+* round-trip identity for arbitrary nested values on the pure-Python
+  reference implementation;
+* canonicality: semantically equal inputs encode to identical bytes
+  (dict insertion order must not matter — this is what makes signing
+  bytes canonical);
+* the C extension agrees byte-for-byte with the Python reference, on
+  valid values AND on arbitrary garbage (accept/reject must match: a
+  divergence would let an attacker craft frames that split replicas).
+"""
+
+import string
+
+import pytest
+
+hypothesis = pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+from mochi_tpu.protocol import (  # noqa: E402
+    Envelope,
+    HelloToServer,
+    decode_envelope,
+    encode_envelope,
+)
+from mochi_tpu.protocol.codec import _decode_py, _encode_py  # noqa: E402
+
+try:
+    from mochi_tpu.native import get_mcode
+
+    _native = get_mcode()
+except Exception:  # pragma: no cover - cc unavailable
+    _native = None
+
+needs_native = pytest.mark.skipif(_native is None, reason="no C toolchain")
+
+
+# mcode value domain: None/bool/int/bytes/str and lists/dicts thereof
+_scalar = st.one_of(
+    st.none(),
+    st.booleans(),
+    st.integers(min_value=-(2**63), max_value=2**63 - 1),
+    st.binary(max_size=64),
+    st.text(max_size=32),
+)
+_value = st.recursive(
+    _scalar,
+    lambda children: st.one_of(
+        st.lists(children, max_size=6),
+        st.dictionaries(st.text(string.printable, max_size=8), children, max_size=6),
+    ),
+    max_leaves=25,
+)
+
+
+@settings(max_examples=200, deadline=None)
+@given(_value)
+def test_python_codec_roundtrip(value):
+    assert _decode_py(_encode_py(value)) == value
+
+
+@settings(max_examples=100, deadline=None)
+@given(st.dictionaries(st.text(max_size=8), _scalar, max_size=8))
+def test_canonical_dict_order(d):
+    """Insertion order must not leak into the canonical bytes."""
+    reordered = dict(sorted(d.items(), reverse=True))
+    assert _encode_py(reordered) == _encode_py(d)
+    if _native is not None:
+        assert _native.encode(reordered) == _native.encode(d)
+
+
+@needs_native
+@settings(max_examples=200, deadline=None)
+@given(_value)
+def test_native_matches_python(value):
+    blob = _encode_py(value)
+    assert _native.encode(value) == blob  # byte-identical canonical form
+    assert _native.decode(blob) == value
+
+
+@needs_native
+@settings(max_examples=150, deadline=None)
+@given(st.binary(max_size=96))
+def test_decoders_never_crash_and_agree_on_garbage(blob):
+    """Arbitrary bytes either decode identically on both paths or raise on
+    both — a divergence would let an attacker craft frames that one replica
+    accepts and another rejects."""
+    try:
+        py_val = _decode_py(blob)
+        py_ok = True
+    except Exception:
+        py_ok = False
+    try:
+        c_val = _native.decode(blob)
+        c_ok = True
+    except Exception:
+        c_ok = False
+    assert py_ok == c_ok
+    if py_ok:
+        assert py_val == c_val
+
+
+@settings(max_examples=50, deadline=None)
+@given(
+    msg=st.text(max_size=24),
+    msg_id=st.text(string.hexdigits, min_size=1, max_size=32),
+    sender=st.text(max_size=24),
+    reply_to=st.one_of(st.none(), st.text(max_size=16)),
+    ts=st.integers(min_value=0, max_value=2**53),
+    sig=st.one_of(st.none(), st.binary(min_size=64, max_size=64)),
+    mac=st.one_of(st.none(), st.binary(min_size=32, max_size=32)),
+)
+def test_envelope_roundtrip(msg, msg_id, sender, reply_to, ts, sig, mac):
+    env = Envelope(HelloToServer(msg), msg_id, sender, reply_to, ts, sig, mac)
+    back = decode_envelope(encode_envelope(env))
+    assert back.payload == env.payload
+    assert (back.msg_id, back.sender_id, back.reply_to) == (msg_id, sender, reply_to)
+    assert (back.timestamp_ms, back.signature, back.mac) == (ts, sig, mac)
+    # auth bytes never cover the auth fields
+    assert back.signing_bytes() == env.signing_bytes()
+
+
+def test_recode_signed4_exact_over_random_scalars():
+    """Vectorized check: sum(mag * (-1)^neg * 16^k) reconstructs the scalar
+    exactly for random scalars < 2^253 plus the edge cases."""
+    import numpy as np
+
+    import jax
+    from mochi_tpu.crypto.curve import digits4_from_bits, recode_signed4
+
+    rng = np.random.default_rng(7)
+    scalars = [0, 1, (1 << 253) - 1, (1 << 252) + 27742317777372353535851937790883648492]
+    scalars += [int.from_bytes(rng.bytes(32), "little") & ((1 << 253) - 1) for _ in range(60)]
+    bits = np.zeros((len(scalars), 256), dtype=np.int32)
+    for i, s in enumerate(scalars):
+        bits[i] = np.unpackbits(
+            np.frombuffer(s.to_bytes(32, "little"), dtype=np.uint8), bitorder="little"
+        )
+    dig = digits4_from_bits(bits.T)
+    mag, neg = jax.jit(recode_signed4)(dig)
+    mag = np.asarray(mag)
+    neg = np.asarray(neg)
+    assert mag.max() <= 8
+    for i, s in enumerate(scalars):
+        acc = 0
+        for k in range(64):
+            d = int(mag[k, i]) * (-1 if neg[k, i] else 1)
+            acc += d * (16**k)
+        assert acc == s, (i, s)
