@@ -11,7 +11,9 @@ FROM python:3.12-slim
 
 RUN apt-get update && apt-get install -y --no-install-recommends gcc libc6-dev \
     && rm -rf /var/lib/apt/lists/*
-RUN pip install --no-cache-dir jax cryptography numpy hypothesis pytest
+# (test deps — pytest, hypothesis — deliberately NOT baked into the
+# production image; tests/ is not COPYed either)
+RUN pip install --no-cache-dir jax cryptography numpy
 
 WORKDIR /app
 COPY mochi_tpu ./mochi_tpu
