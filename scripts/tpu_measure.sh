@@ -1,0 +1,61 @@
+#!/usr/bin/env bash
+# One-shot TPU measurement battery for mochi-tpu.
+#
+# Chip time is scarce (the round-2 tunnel died mid-session after one
+# capture); this script grabs EVERYTHING in one sitting, cheapest-first,
+# so a partial run still leaves artifacts:
+#
+#   1. liveness probe (watchdogged, throwaway subprocess)
+#   2. headline bench.py  -> BENCH-style JSON (+ per-batch table, MFU)
+#   3. MAX_BUCKET sweep   -> is 8192 the new peak post-signed-windows?
+#   4. run_all --publish  -> benchmarks/results_r<N>.json + BASELINE.json
+#   5. config1 with the shared TPU verifier service
+#
+# Usage: scripts/tpu_measure.sh [round-suffix]   (default: next free)
+set -uo pipefail
+REPO_DIR=$(cd "$(dirname "$0")/.." && pwd)
+cd "$REPO_DIR"
+export PYTHONPATH="${REPO_DIR}${PYTHONPATH:+:$PYTHONPATH}"
+ROUND=${1:-03}
+OUT="benchmarks/tpu_measure_r${ROUND}.log"
+
+echo "== 1. liveness" | tee "$OUT"
+if ! timeout 120 python -c "import jax; d=jax.devices()[0]; assert d.platform=='tpu'; print('chip:', d)" >>"$OUT" 2>&1; then
+  echo "TPU unreachable (see $OUT); aborting before wasting budget" | tee -a "$OUT"
+  exit 1
+fi
+
+echo "== 2. headline bench" | tee -a "$OUT"
+timeout 2400 python bench.py | tee -a "$OUT"
+
+echo "== 3. MAX_BUCKET sweep (is 8192 the post-signed-window peak?)" | tee -a "$OUT"
+for mb in 4096 8192; do
+  MOCHI_MAX_BUCKET=$mb timeout 900 python - <<'EOF' 2>&1 | tee -a "$OUT"
+import os, time, numpy as np, jax
+jax.config.update("jax_compilation_cache_dir", ".jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+from mochi_tpu.crypto import batch_verify, keys
+from mochi_tpu.verifier.spi import VerifyItem
+mb = batch_verify.MAX_BUCKET
+kp = keys.generate_keypair()
+items = [VerifyItem(kp.public_key, b"s%d" % i, kp.sign(b"s%d" % i)) for i in range(mb)]
+batch_verify.verify_batch(items)  # compile
+t0 = time.perf_counter(); out = batch_verify.verify_batch(items)
+dt = time.perf_counter() - t0
+assert all(out)
+print(f"MAX_BUCKET={mb}: {mb/dt:.1f} sigs/s ({dt*1e3:.1f} ms)")
+EOF
+done
+
+echo "== 4. publish all configs" | tee -a "$OUT"
+MOCHI_BENCH_ROUND="$ROUND" timeout 5400 python -m benchmarks.run_all --publish 2>&1 | tee -a "$OUT"
+
+echo "== 5. config1 via shared TPU verifier service" | tee -a "$OUT"
+timeout 1200 python -c "
+import jax, json
+jax.config.update('jax_compilation_cache_dir', '.jax_cache')
+from benchmarks import config1_cluster
+print(json.dumps(config1_cluster.run(5, 40, 2, verifier='service')))
+" 2>&1 | tee -a "$OUT"
+
+echo "DONE — commit benchmarks/results_r${ROUND}.json, BASELINE.json and $OUT" | tee -a "$OUT"
