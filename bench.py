@@ -261,8 +261,8 @@ def _run_child(force_cpu: bool, timeout_s: float, alive_timeout_s: float = 120.0
     deadline = time.monotonic() + alive_timeout_s
     try:
         while True:
-            if scan("BENCH_JSON ") is not None:
-                line = scan("BENCH_JSON ")
+            line = scan("BENCH_JSON ")
+            if line is not None:
                 proc.wait()
                 return json.loads(line[len("BENCH_JSON "):]), None
             if not alive and scan("BENCH_ALIVE") is not None:

@@ -27,9 +27,6 @@ def _pct(samples: List[float], q: float) -> float:
 async def _run(
     n_clients: int, keys_per_client: int, sweeps: int, verifier: str = "service"
 ) -> Dict:
-    from mochi_tpu.client.txn import TransactionBuilder
-    from mochi_tpu.testing.virtual_cluster import VirtualCluster
-
     # The measured topology mirrors a real deployment (VERDICT r1 weak #5):
     # every replica ships signature batches to ONE shared verifier service
     # (the TPU owner) over the mcode transport; the service batches across

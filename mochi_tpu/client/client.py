@@ -135,7 +135,7 @@ class MochiDBClient:
         )
         session_key = self._sessions.get(sid) if sid is not None else None
         if session_key is not None and not self._needs_signature(payload):
-            return env.with_mac(session_crypto.mac(session_key, env.signing_bytes()))
+            return session_crypto.seal(env, session_key)
         return env.with_signature(self.keypair.sign(env.signing_bytes()))
 
     def _authentic(self, sid: str, env: Envelope) -> bool:
@@ -203,9 +203,11 @@ class MochiDBClient:
     ) -> Dict[str, object]:
         """Fan a payload to the replica set; keep only authentic responses."""
         targets = self._targets(transaction)
-        await asyncio.gather(
-            *(self._ensure_session(sid, info) for sid, info in targets)
-        )
+        missing = [t for t in targets if t[0] not in self._sessions]
+        if missing:  # skip coroutine+gather setup on the steady-state path
+            await asyncio.gather(
+                *(self._ensure_session(sid, info) for sid, info in missing)
+            )
         results = await fan_out(
             self.pool,
             targets,

@@ -302,10 +302,9 @@ def double_scalar_mul_windowed(
     p_mag, p_neg = recode_signed4(p_dig)
     a_tab = _small_multiples_table(p_point)
     if b_tab is None:
-        b_tab = (
-            jnp.asarray(_B_TAB_YPX)[..., None] if lanes else jnp.asarray(_B_TAB_YPX),
-            jnp.asarray(_B_TAB_YMX)[..., None] if lanes else jnp.asarray(_B_TAB_YMX),
-            jnp.asarray(_B_TAB_XY2D)[..., None] if lanes else jnp.asarray(_B_TAB_XY2D),
+        b_tab = tuple(
+            jnp.asarray(t)[..., None] if lanes else jnp.asarray(t)
+            for t in (_B_TAB_YPX, _B_TAB_YMX, _B_TAB_XY2D)
         )
 
     if MOSAIC_SAFE:

@@ -88,5 +88,12 @@ def mac(session_key: bytes, data: bytes) -> bytes:
     return hmac.new(session_key, data, hashlib.sha256).digest()
 
 
+def seal(envelope, session_key: bytes):
+    """Attach the session MAC over an envelope's canonical auth bytes —
+    the one sealing idiom shared by clients, replicas and the verifier
+    service."""
+    return envelope.with_mac(mac(session_key, envelope.signing_bytes()))
+
+
 def mac_ok(session_key: bytes, data: bytes, tag: bytes) -> bool:
     return hmac.compare_digest(mac(session_key, data), tag)

@@ -24,7 +24,14 @@ import struct
 from typing import Awaitable, Callable, Dict, Iterable, List, Optional, Tuple
 
 from ..cluster.config import ServerInfo
-from ..protocol import Envelope, decode_envelope, encode_envelope
+from ..protocol import (
+    Envelope,
+    HelloToServer,
+    ReadToServer,
+    Write1ToServer,
+    decode_envelope,
+    encode_envelope,
+)
 
 LOG = logging.getLogger(__name__)
 
@@ -79,7 +86,7 @@ class RpcServer:
     # would serialize the very requests the batcher wants to coalesce).
     # Everything else (Write2's certificate batch, sync pulls) gets its own
     # task so a slow request can't head-of-line-block the channel.
-    INLINE_TYPES = ("ReadToServer", "Write1ToServer", "HelloToServer")
+    INLINE_TYPES = (ReadToServer, Write1ToServer, HelloToServer)
 
     async def _serve_conn(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
@@ -96,7 +103,7 @@ class RpcServer:
                 except Exception:
                     LOG.exception("undecodable frame from %s; closing", peer)
                     break
-                if env.mac is not None and type(env.payload).__name__ in self.INLINE_TYPES:
+                if env.mac is not None and isinstance(env.payload, self.INLINE_TYPES):
                     await self._handle_one(env, writer, write_lock)
                     continue
                 # Handle concurrently so one slow request (e.g. awaiting a

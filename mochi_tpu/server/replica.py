@@ -303,9 +303,7 @@ class MochiReplica:
         if not force_sign and env.mac is not None:
             session_key = self._sessions.get(env.sender_id)
         if session_key is not None:
-            return response.with_mac(
-                session_crypto.mac(session_key, response.signing_bytes())
-            )
+            return session_crypto.seal(response, session_key)
         return response.with_signature(self.keypair.sign(response.signing_bytes()))
 
     async def handle_envelope(self, env: Envelope) -> Optional[Envelope]:

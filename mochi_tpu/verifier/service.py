@@ -72,7 +72,7 @@ def _seal(env: Envelope, secret: Optional[bytes]) -> Envelope:
     place the sealing scheme lives for requests, responses and failures."""
     if secret is None:
         return env
-    return env.with_mac(session_crypto.mac(secret, env.signing_bytes()))
+    return session_crypto.seal(env, secret)
 
 
 def load_secret(path: str) -> bytes:
