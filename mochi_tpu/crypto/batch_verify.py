@@ -39,21 +39,21 @@ from ..verifier.spi import VerifyItem
 LOG = logging.getLogger(__name__)
 
 MIN_BUCKET = 16
-# Largest single device launch.  Measured on v5e (BENCH r2): 4096 lanes is
-# the throughput peak — the per-item small-multiples tables are ~4.4 MB per
-# coordinate at 4096 lanes and spill VMEM beyond that (16384 halves the
-# rate, 65536 is 6x slower).  Bigger requests are chunked at this size, so
-# rate stays flat instead of regressing.  The signed-window ladder halved
-# the table footprint, so the peak may have moved to 8192 — re-measure with
-# bench.py and tune via MOCHI_MAX_BUCKET without a code change.
+# Largest single device launch.  Measured on v5e (bench.py, round 2): 8192
+# lanes is the throughput peak (63.6k sigs/s) after the signed-window
+# ladder halved the per-item small-multiples tables and the pad-skew
+# multiply removed the HBM-streaming intermediates; 16384 still spills
+# VMEM and runs ~15% slower, 4096 underfills (42.5k).  Bigger requests are
+# chunked at this size, so rate stays flat instead of regressing.  Tune
+# via MOCHI_MAX_BUCKET without a code change.
 def _max_bucket() -> int:
     """MOCHI_MAX_BUCKET, sanitized: >= MIN_BUCKET and a power of two (a
     non-power would chunk at sizes _bucket_size pads PAST the VMEM cap the
     knob exists to enforce; 0/negative would break the chunk loop)."""
     try:
-        v = int(os.environ.get("MOCHI_MAX_BUCKET", "4096"))
+        v = int(os.environ.get("MOCHI_MAX_BUCKET", "8192"))
     except ValueError:
-        return 4096
+        return 8192
     v = max(v, MIN_BUCKET)
     return 1 << (v.bit_length() - 1)  # round DOWN to a power of two
 

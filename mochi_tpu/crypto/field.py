@@ -24,14 +24,21 @@ Design notes (why this representation — round-2 rework):
   < 2^26; ``_carry2`` output <= 32786 <= LOOSE.  Exact canonical reduction
   (sequential chain + conditional subtract) happens only in :func:`canonical`
   — i.e. a handful of times per verify, not thousands.
-* **Column accumulation is a reshape, not a loop.**  The 17x17 partial-
-  product anti-diagonal sums ("columns") are computed with the pad/reshape
-  skewing trick (:func:`_skew_cols`): 3 XLA ops instead of round-1's 32
-  dynamic-slice updates.  This is what cuts the traced graph from ~300 to
-  ~25 HLO ops per multiply, and XLA-CPU compile of the full verifier from
-  minutes to seconds (VERDICT.md round-1 item 4).  Inside Pallas/Mosaic
-  kernels (where sublane-dim reshapes are restricted) the same columns are
-  built by unrolled static-slice adds — select with :data:`SKEW_IMPL`.
+* **Column accumulation is 17 shifted pad+adds.**  The 17x17 partial-
+  product anti-diagonal sums ("columns") are built by padding each row to
+  its shifted position and summing (:func:`_skew_cols_pad`) — ~35 fusable
+  elementwise ops, no relayout.  The round-2a "reshape" variant (3 XLA
+  ops via a flatten/reshape skew) compiles equally fast but runs 3.4x
+  slower on v5e: the reshape is a relayout + fusion barrier, so the
+  (17, 34, B) intermediates stream through HBM (~27 us/mul at B=4096,
+  consistent with HBM bandwidth on ~40 MB of intermediates) where the
+  pad form stays VMEM-fused (~7.9 us/mul) — scripts/mul_microbench.py.
+  Either way the traced graph is ~25-35 HLO ops per multiply vs round-1's
+  ~300 (32 dynamic-slice updates), which is what cut XLA-CPU compile of
+  the full verifier from minutes to seconds (VERDICT.md round-1 item 4).
+  Inside Pallas/Mosaic kernels (where sublane-dim reshapes are
+  restricted) the same columns are built by unrolled static-slice adds —
+  select with :data:`SKEW_IMPL`.
 * No data-dependent control flow — everything is branchless select/arith
   so the whole verifier jits into one XLA program (SURVEY.md §7).
 
@@ -65,10 +72,18 @@ L_INT = (1 << 252) + 27742317777372353535851937790883648493
 BX_INT = 15112221349535400772501151409588531511454012693041857206046113283949847762202
 BY_INT = 46316835694926478169428394003475163141307993866256225615783033603165251855960
 
-# How to build schoolbook columns: "reshape" (XLA: 3 ops) or "shift"
-# (unrolled static-slice adds — required inside Mosaic kernels, where
-# reshapes that touch the sublane dim are restricted).
-SKEW_IMPL = "reshape"
+# How to build schoolbook columns: "pad" (17 shifted pad+adds — no
+# relayout, fuses into the partial-product computation; measured 3.4x
+# faster than "reshape" on v5e at (17, 4096): 7.9 vs 27.0 us/mul,
+# scripts/mul_microbench.py), "reshape" (3 XLA ops but the flatten/
+# reshape is a relayout + fusion barrier on TPU), or "shift" (unrolled
+# static-slice adds — required inside Mosaic kernels, where reshapes
+# that touch the sublane dim are restricted).
+SKEW_IMPL = "pad"
+
+
+def available_skews():
+    return ("pad", "reshape", "shift")
 
 # How to materialize limb constants: "array" (one XLA literal — default) or
 # "scalars" (per-limb jnp.full from python ints — required inside Pallas
@@ -253,9 +268,28 @@ def _skew_cols_shift(x: jnp.ndarray) -> jnp.ndarray:
     return cols
 
 
+def _skew_cols_pad(x: jnp.ndarray) -> jnp.ndarray:
+    """Same columns via 17 shifted pad+adds: cols += pad(x[i], (i, 16-i)).
+
+    Each term is an elementwise add of a sublane-shifted (17->33, lanes)
+    slice — no flatten/reshape relayout, so XLA can fuse the whole column
+    accumulation into the partial-product computation instead of
+    materializing the (17, 34, lanes) skew intermediates in HBM.
+    """
+    n = NLIMBS
+    lanes = x.shape[2:]
+    lane_pad = [(0, 0)] * len(lanes)
+    cols = jnp.pad(x[0], [(0, n - 1), *lane_pad])
+    for i in range(1, n):
+        cols = cols + jnp.pad(x[i], [(i, n - 1 - i), *lane_pad])
+    return cols
+
+
 def _skew_cols(x: jnp.ndarray) -> jnp.ndarray:
     if SKEW_IMPL == "reshape":
         return _skew_cols_reshape(x)
+    if SKEW_IMPL == "pad":
+        return _skew_cols_pad(x)
     return _skew_cols_shift(x)
 
 
@@ -281,7 +315,43 @@ def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 
 
 def square(a: jnp.ndarray) -> jnp.ndarray:
-    return mul(a, a)
+    """a^2 via the symmetric schoolbook: only the upper triangle of the
+    partial products is computed (153 int32 multiplies vs mul's 289),
+    off-diagonal terms doubled.  Doubling happens AFTER the lo/hi split —
+    doubling a product first would overflow int32 (2*LOOSE^2 > 2^31).
+
+    Column values are exactly those of ``mul(a, a)`` (the doubled upper
+    triangle equals the full ordered sum), so the mul bounds apply
+    unchanged: columns < 2^21, folded < 2^26 -> :func:`_carry2`.
+    Roughly 36% of the verifier's field muls are squarings (the ladder's
+    doublings and the decompression power chains), so the ~47% product
+    saving here is a measurable slice of the whole pipeline
+    (scripts/mul_microbench.py).
+    """
+    n = NLIMBS
+    lanes = a.shape[1:]
+    lane_pad = [(0, 0)] * len(lanes)
+    cols_lo = None
+    cols_hi = None
+    for i in range(n):
+        prod = a[i] * a[i:]  # (n-i, lanes), <= LOOSE^2 < 2^31
+        lo = prod & MASK
+        hi = prod >> RADIX
+        # double off-diagonal (j > i) terms; diagonal stays single
+        lo = jnp.concatenate([lo[:1], lo[1:] * 2], axis=0)
+        hi = jnp.concatenate([hi[:1], hi[1:] * 2], axis=0)
+        # row i covers columns k = i+j for j in [i, n): left pad 2i,
+        # right pad (2n-2) - (i+n-1) = n-1-i
+        lo = jnp.pad(lo, [(2 * i, n - 1 - i), *lane_pad])
+        hi = jnp.pad(hi, [(2 * i, n - 1 - i), *lane_pad])
+        cols_lo = lo if cols_lo is None else cols_lo + lo
+        cols_hi = hi if cols_hi is None else cols_hi + hi
+    pad_lane = [(0, 0)] * (cols_lo.ndim - 1)
+    cols = jnp.pad(cols_lo, [(0, 1), *pad_lane]) + jnp.pad(
+        cols_hi, [(1, 0), *pad_lane]
+    )
+    folded = cols[:NLIMBS] + 19 * cols[NLIMBS:]
+    return _carry2(folded)
 
 
 def mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:

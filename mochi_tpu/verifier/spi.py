@@ -115,7 +115,7 @@ class BatchingVerifier(SignatureVerifier):
     def __init__(
         self,
         backend: BatchBackend,
-        max_batch: int = 4096,
+        max_batch: int = 8192,
         max_delay_s: float = 0.002,
         fallback: Optional[SignatureVerifier] = None,
     ):

@@ -35,7 +35,7 @@ class TpuBatchVerifier(BatchingVerifier):
     def __init__(
         self,
         device: Optional[jax.Device] = None,
-        max_batch: int = 4096,
+        max_batch: int = 8192,
         max_delay_s: float = 0.002,
         fallback: Optional[SignatureVerifier] = None,
         warmup_buckets: Sequence[int] = (),
