@@ -293,25 +293,32 @@ def _skew_cols(x: jnp.ndarray) -> jnp.ndarray:
     return _skew_cols_shift(x)
 
 
-def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """Schoolbook 17x17-limb multiply, radix 2^15, fold at 2^255 === 19.
-
-    Bounds: loose limbs <= LOOSE -> products <= LOOSE^2 = 1.080e9 < 2^31
-    (int32-safe, no uint32 casts).  lo < 2^15, hi = prod >> 15 <= 32965.
-    Columns: <= 17 terms each for lo and hi -> < 2^21; after the 19-fold
-    of the high 17 columns: < 20 * 2^21 < 2^26 -> :func:`_carry2`.
-    """
-    prod = a[:, None] * b[None, :]  # (17, 17, lanes) int32
-    lo = prod & MASK
-    hi = prod >> RADIX
-    cols_lo = _skew_cols(lo)  # (33, lanes), cols of sum lo[i,j] at i+j
-    cols_hi = _skew_cols(hi)  # hi contributes at i+j+1
+def _fold_carry(cols_lo: jnp.ndarray, cols_hi: jnp.ndarray) -> jnp.ndarray:
+    """Combine lo/hi column sums (hi shifted one limb up), fold the high 17
+    columns at 2^255 === 19, and restore the loose-limb invariant.
+    Precondition (both callers prove it): columns < 2^21, so the folded
+    columns are < 20 * 2^21 < 2^26 -> :func:`_carry2`."""
     pad_lane = [(0, 0)] * (cols_lo.ndim - 1)
     cols = jnp.pad(cols_lo, [(0, 1), *pad_lane]) + jnp.pad(
         cols_hi, [(1, 0), *pad_lane]
     )  # (34, lanes)
     folded = cols[:NLIMBS] + 19 * cols[NLIMBS:]
     return _carry2(folded)
+
+
+def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Schoolbook 17x17-limb multiply, radix 2^15, fold at 2^255 === 19.
+
+    Bounds: loose limbs <= LOOSE -> products <= LOOSE^2 = 1.080e9 < 2^31
+    (int32-safe, no uint32 casts).  lo < 2^15, hi = prod >> 15 <= 32965.
+    Columns: <= 17 terms each for lo and hi -> < 2^21 -> :func:`_fold_carry`.
+    """
+    prod = a[:, None] * b[None, :]  # (17, 17, lanes) int32
+    lo = prod & MASK
+    hi = prod >> RADIX
+    cols_lo = _skew_cols(lo)  # (33, lanes), cols of sum lo[i,j] at i+j
+    cols_hi = _skew_cols(hi)  # hi contributes at i+j+1
+    return _fold_carry(cols_lo, cols_hi)
 
 
 def square(a: jnp.ndarray) -> jnp.ndarray:
@@ -327,7 +334,15 @@ def square(a: jnp.ndarray) -> jnp.ndarray:
     doublings and the decompression power chains), so the ~47% product
     saving here is a measurable slice of the whole pipeline
     (scripts/mul_microbench.py).
+
+    Inside Mosaic kernels the sublane-axis pad/concatenate chain below has
+    no validated lowering — route through :func:`mul`, whose column skews
+    are the Mosaic-vetted forms.  (The kernel is recognizable by either
+    Mosaic-mode flag: ``SKEW_IMPL == "shift"`` or ``CONST_MODE ==
+    "scalars"`` — :mod:`mochi_tpu.crypto.pallas_verify` sets the latter.)
     """
+    if SKEW_IMPL == "shift" or CONST_MODE == "scalars":
+        return mul(a, a)
     n = NLIMBS
     lanes = a.shape[1:]
     lane_pad = [(0, 0)] * len(lanes)
@@ -346,12 +361,7 @@ def square(a: jnp.ndarray) -> jnp.ndarray:
         hi = jnp.pad(hi, [(2 * i, n - 1 - i), *lane_pad])
         cols_lo = lo if cols_lo is None else cols_lo + lo
         cols_hi = hi if cols_hi is None else cols_hi + hi
-    pad_lane = [(0, 0)] * (cols_lo.ndim - 1)
-    cols = jnp.pad(cols_lo, [(0, 1), *pad_lane]) + jnp.pad(
-        cols_hi, [(1, 0), *pad_lane]
-    )
-    folded = cols[:NLIMBS] + 19 * cols[NLIMBS:]
-    return _carry2(folded)
+    return _fold_carry(cols_lo, cols_hi)
 
 
 def mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:
