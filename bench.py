@@ -5,7 +5,9 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 The metric is device signature-verification throughput (sigs/sec), peak over
 several batch sizes (BASELINE.json config 2 range) and over both device
 implementations (XLA and the Pallas kernel — the per-impl table ships in the
-"impls" key).  ``vs_baseline`` is the speedup over the reference-analog CPU
+"impls" key), then re-measured with 4-8 batches in flight at the best size
+(steady-state pipelining: overlaps the dispatch/tunnel round trip with
+device execution, as the loaded BatchingVerifier does).  ``vs_baseline`` is the speedup over the reference-analog CPU
 path measured in the same run — one OpenSSL (via ``cryptography``) Ed25519
 verify per signature on this host, single-thread, the stand-in for the
 reference's intended BouncyCastle verifier (the reference itself never
@@ -160,6 +162,29 @@ def _measure() -> dict:
         key=lambda kv: kv[1][1],
     )
 
+    # ---- pipelined steady-state at the best batch -----------------------
+    # Sequential timing charges every batch the full dispatch + tunnel
+    # round trip; a loaded verifier keeps several batches in flight (JAX
+    # dispatch is async), overlapping RTT with device execution.  This is
+    # the rate the BatchingVerifier/service sustains under load, and the
+    # honest headline for a throughput metric (scripts/pipeline_bench.py
+    # measured 118.6k sigs/s at depth 8 vs 63.6-92k sequential on v5e).
+    pipeline = None
+    if best_impl == "xla" and dev.platform == "tpu":
+        _, args = prepared(best_batch)
+        jax.block_until_ready(fn(*args))
+        pipeline = {}
+        for depth in (4, 8):
+            rates = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                jax.block_until_ready([fn(*args) for _ in range(depth)])
+                rates.append(depth * best_batch / (time.perf_counter() - t0))
+            pipeline[depth] = round(max(rates), 1)
+        pipe_best = max(pipeline.values())
+        if pipe_best > best_rate:
+            best_rate = pipe_best
+
     # ---- CPU baselines --------------------------------------------------
     items, _ = prepared(1024)
     sample = items[:256]
@@ -183,6 +208,7 @@ def _measure() -> dict:
         "platform": dev.platform,
         "impl": best_impl,
         "best_batch": best_batch,
+        "pipelined_sigs_per_sec_by_depth": pipeline,
         "impls": impls,
         "cpu_openssl_sigs_per_sec": round(cpu_rate, 1),
         "cpu_allcores_sigs_per_sec": round(cpu_allcores, 1),

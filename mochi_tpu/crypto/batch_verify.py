@@ -75,8 +75,65 @@ def _bucket_size(n: int) -> int:
     return m
 
 
+# 15-bit limb weights and the uint64-word forms of p and L, for the
+# vectorized prechecks below.
+_W15 = (1 << np.arange(15, dtype=np.int32)).astype(np.int32)
+_P_WORDS = [(F.P_INT >> (64 * k)) & ((1 << 64) - 1) for k in range(4)]
+_L_WORDS = [(F.L_INT >> (64 * k)) & ((1 << 64) - 1) for k in range(4)]
+
+
+def _bits_le(rows: np.ndarray) -> np.ndarray:
+    """(n, 32) uint8 -> (n, 256) little-endian bits (uint8)."""
+    return np.unpackbits(rows, axis=1, bitorder="little")
+
+
+def _bits_to_limbs(bits: np.ndarray) -> np.ndarray:
+    """(n, 256) LE bits -> (n, 17) int32 limbs (bit 255 never read: only
+    bits 0..254 enter the limbs, which is exactly the & (2^255-1) mask)."""
+    return (
+        bits[:, :255].reshape(-1, F.NLIMBS, F.RADIX).astype(np.int32) @ _W15
+    )
+
+
+def _words_le(rows: np.ndarray) -> np.ndarray:
+    """(n, 32) uint8 -> (n, 4) uint64 little-endian words."""
+    return rows.view("<u8")
+
+
+def _lt_p(words: np.ndarray) -> np.ndarray:
+    """value < p = 2^255-19, for values already masked below 2^255.
+    p's words are (0xff..ed, ff.., ff.., 0x7fff..): >= p requires the top
+    three words saturated and word0 >= 0xff..ed."""
+    w0, w1, w2, w3 = (words[:, k] for k in range(4))
+    ge = (
+        (w3 == _P_WORDS[3]) & (w2 == _P_WORDS[2]) & (w1 == _P_WORDS[1])
+        & (w0 >= _P_WORDS[0])
+    )
+    return ~ge
+
+
+def _lt_l(words: np.ndarray) -> np.ndarray:
+    """value < L (group order), lexicographic compare from the top word."""
+    w0, w1, w2, w3 = (words[:, k] for k in range(4))
+    ge = (w3 > _L_WORDS[3]) | (
+        (w3 == _L_WORDS[3])
+        & ((w2 > _L_WORDS[2]) | ((w2 == _L_WORDS[2]) & (
+            (w1 > _L_WORDS[1]) | ((w1 == _L_WORDS[1]) & (w0 >= _L_WORDS[0]))
+        )))
+    )
+    return ~ge
+
+
 def prepare(items: Sequence[VerifyItem]):
-    """Host-side packing: items -> dict of numpy tensors + precheck bitmap."""
+    """Host-side packing: items -> fixed-shape numpy tensors + precheck bitmap.
+
+    Vectorized over the batch (numpy byte/bit ops; the only per-item Python
+    is SHA-512 — hashlib's C — and the mod-L bignum): ~8 us/item vs the
+    round-2a per-item loop's ~114 us/item, which capped the end-to-end
+    service at ~9k items/s in front of a >100k items/s device pipeline.
+    Semantics unchanged: malformed lengths, non-canonical y (>= p) and
+    S >= L are rejected on host exactly as RFC 8032 decode / OpenSSL do.
+    """
     n = len(items)
     y_a = np.zeros((n, F.NLIMBS), dtype=np.int32)
     y_r = np.zeros((n, F.NLIMBS), dtype=np.int32)
@@ -86,37 +143,68 @@ def prepare(items: Sequence[VerifyItem]):
     h_bits = np.zeros((n, 256), dtype=np.int32)
     pre_ok = np.zeros(n, dtype=bool)
 
-    for i, it in enumerate(items):
-        if len(it.public_key) != 32 or len(it.signature) != 64:
-            continue
-        a_bytes = bytes(it.public_key)
-        r_bytes = bytes(it.signature[:32])
-        s_int = int.from_bytes(it.signature[32:], "little")
-        ya = int.from_bytes(a_bytes, "little") & ((1 << 255) - 1)
-        yr = int.from_bytes(r_bytes, "little") & ((1 << 255) - 1)
-        # RFC 8032 decode rejects non-canonical y and S >= L (as OpenSSL does)
-        if ya >= F.P_INT or yr >= F.P_INT or s_int >= F.L_INT:
-            continue
+    idx = [
+        i
+        for i, it in enumerate(items)
+        if len(it.public_key) == 32 and len(it.signature) == 64
+    ]
+    if not idx:
+        return y_a, sign_a, y_r, sign_r, s_bits, h_bits, pre_ok
+    m = len(idx)
+
+    a_rows = np.frombuffer(
+        b"".join(bytes(items[i].public_key) for i in idx), dtype=np.uint8
+    ).reshape(m, 32)
+    sig_rows = np.frombuffer(
+        b"".join(bytes(items[i].signature) for i in idx), dtype=np.uint8
+    ).reshape(m, 64)
+    r_rows = np.ascontiguousarray(sig_rows[:, :32])
+    s_rows = np.ascontiguousarray(sig_rows[:, 32:])
+
+    a_bits = _bits_le(a_rows)
+    r_bits = _bits_le(r_rows)
+    sa = a_bits[:, 255].astype(np.int32)
+    sr = r_bits[:, 255].astype(np.int32)
+
+    # canonicity: y < p on the masked value, S < L on the raw scalar
+    a_masked = a_rows.copy()
+    a_masked[:, 31] &= 0x7F
+    r_masked = r_rows.copy()
+    r_masked[:, 31] &= 0x7F
+    ok = _lt_p(_words_le(a_masked)) & _lt_p(_words_le(r_masked))
+    ok &= _lt_l(_words_le(s_rows))
+
+    # h = SHA-512(R || A || M) mod L — per item: hashlib C + one bignum
+    # mod, and ONLY for items that passed the prechecks (a flood of
+    # non-canonical signatures over big messages must not buy host
+    # hashing work; rejected lanes are masked by pre_ok regardless).
+    idx_arr = np.asarray(idx)
+    ok_idx = idx_arr[ok]
+    h_parts = []
+    for i in ok_idx:
+        it = items[i]
         h_int = (
             int.from_bytes(
-                hashlib.sha512(r_bytes + a_bytes + bytes(it.message)).digest(),
+                hashlib.sha512(
+                    bytes(it.signature[:32])
+                    + bytes(it.public_key)
+                    + bytes(it.message)
+                ).digest(),
                 "little",
             )
             % F.L_INT
         )
-        y_a[i] = F.int_to_limbs(ya)
-        y_r[i] = F.int_to_limbs(yr)
-        sign_a[i] = a_bytes[31] >> 7
-        sign_r[i] = r_bytes[31] >> 7
-        s_bits[i] = np.unpackbits(
-            np.frombuffer(s_int.to_bytes(32, "little"), dtype=np.uint8),
-            bitorder="little",
-        )
-        h_bits[i] = np.unpackbits(
-            np.frombuffer(h_int.to_bytes(32, "little"), dtype=np.uint8),
-            bitorder="little",
-        )
-        pre_ok[i] = True
+        h_parts.append(h_int.to_bytes(32, "little"))
+    if h_parts:
+        h_rows = np.frombuffer(b"".join(h_parts), dtype=np.uint8).reshape(-1, 32)
+        h_bits[ok_idx] = _bits_le(h_rows)
+
+    y_a[idx_arr] = _bits_to_limbs(a_bits)
+    y_r[idx_arr] = _bits_to_limbs(r_bits)
+    sign_a[idx_arr] = sa
+    sign_r[idx_arr] = sr
+    s_bits[idx_arr] = _bits_le(s_rows)
+    pre_ok[idx_arr] = ok
     return y_a, sign_a, y_r, sign_r, s_bits, h_bits, pre_ok
 
 

@@ -1,0 +1,77 @@
+"""Pipelined verify throughput: hide the host<->device round trip.
+
+bench.py's per-batch numbers time sequential blocking calls, so each batch
+pays the full dispatch+tunnel round trip on top of device time.  JAX
+dispatch is async: submitting D batches before blocking overlaps the RTT
+of batch k with device execution of batch k-1 — the steady-state rate a
+loaded verifier service actually sustains.
+
+Usage: python scripts/pipeline_bench.py [batch ...]   (default 8192 16384)
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_compilation_cache_dir", ".jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+
+sys.path.insert(0, ".")
+
+from mochi_tpu.crypto import batch_verify, keys  # noqa: E402
+from mochi_tpu.crypto.curve import verify_prepared  # noqa: E402
+from mochi_tpu.verifier.spi import VerifyItem  # noqa: E402
+
+
+def main():
+    batches = [int(a) for a in sys.argv[1:]] or [8192, 16384]
+    dev = jax.devices()[0]
+    print(f"device: {dev.platform} {getattr(dev, 'device_kind', '?')}")
+    kp = keys.generate_keypair()
+    fn = jax.jit(verify_prepared)
+
+    for batch in batches:
+        items = [
+            VerifyItem(kp.public_key, b"p%d" % i, kp.sign(b"p%d" % i))
+            for i in range(batch)
+        ]
+        y_a, sign_a, y_r, sign_r, s_bits, h_bits, pre_ok = batch_verify.prepare(items)
+        args = tuple(
+            jax.device_put(a, dev)
+            for a in (y_a, sign_a, y_r, sign_r, s_bits, h_bits)
+        )
+        out = jax.block_until_ready(fn(*args))
+        assert np.asarray(out).all()
+
+        # sequential (bench.py's method)
+        times = []
+        for _ in range(4):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(*args))
+            times.append(time.perf_counter() - t0)
+        seq = batch / min(times)
+
+        # pipelined at depth D
+        for depth in (2, 4, 8):
+            t0 = time.perf_counter()
+            outs = [fn(*args) for _ in range(depth)]
+            jax.block_until_ready(outs)
+            warm = time.perf_counter() - t0  # first window includes ramp
+            t0 = time.perf_counter()
+            outs = [fn(*args) for _ in range(depth)]
+            jax.block_until_ready(outs)
+            dt = time.perf_counter() - t0
+            rate = depth * batch / dt
+            print(
+                f"batch {batch:6d} depth {depth}:  {rate:10.1f} sigs/s  "
+                f"({dt / depth * 1e3:7.1f} ms/batch; seq {seq:.1f})"
+            )
+
+
+if __name__ == "__main__":
+    main()

@@ -118,3 +118,48 @@ def test_snapshot_wrong_server_refused(tmp_path):
         raise AssertionError("expected ValueError")
     except ValueError as exc:
         assert "server-1" in str(exc)
+
+
+def test_batcher_close_while_flusher_awaits_inflight_slot():
+    """close() while the flusher is blocked on the in-flight semaphore must
+    cancel the still-queued items (round-2 review: the chunk used to be
+    popped BEFORE the acquire, so cancelling the flusher there stranded the
+    popped chunk's futures forever)."""
+    import asyncio
+    import time
+
+    from mochi_tpu.crypto import keys
+    from mochi_tpu.verifier.spi import BatchingVerifier, VerifyItem
+
+    kp = keys.generate_keypair()
+
+    async def main():
+        def slow_backend(chunk):
+            time.sleep(0.2)
+            return [True] * len(chunk)
+
+        bv = BatchingVerifier(
+            slow_backend, max_batch=4, max_delay_s=0.0, max_inflight=2
+        )
+        items = [
+            VerifyItem(kp.public_key, b"m%d" % i, kp.sign(b"m%d" % i))
+            for i in range(40)
+        ]
+        tasks = [
+            asyncio.create_task(bv.verify_batch(items[i * 4 : (i + 1) * 4]))
+            for i in range(10)
+        ]
+        await asyncio.sleep(0.05)  # saturate in-flight, flusher parks on acquire
+        await asyncio.wait_for(bv.close(), timeout=5)
+        hung = 0
+        for t in tasks:
+            try:
+                res = await asyncio.wait_for(t, timeout=5)
+                assert all(res)
+            except (asyncio.CancelledError, RuntimeError):
+                pass
+            except asyncio.TimeoutError:
+                hung += 1
+        assert hung == 0, f"{hung} verify_batch callers hung after close()"
+
+    asyncio.run(main())
