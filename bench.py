@@ -103,7 +103,10 @@ def _measure() -> dict:
         times = []
         for _ in range(5):
             t0 = time.perf_counter()
-            jax.block_until_ready(fn(*args))
+            # np.asarray forces the D2H readback: the only reliable sync
+            # point through the axon relay (block_until_ready can return
+            # before execution completes there, yielding absurd rates).
+            np.asarray(fn(*args))
             times.append(time.perf_counter() - t0)
         rate = batch / min(times)
         xla["per_batch"][batch] = {
@@ -135,7 +138,7 @@ def _measure() -> dict:
                 times = []
                 for _ in range(5):
                     t0 = time.perf_counter()
-                    jax.block_until_ready(verify_prepared_pallas(*args))
+                    np.asarray(verify_prepared_pallas(*args))
                     times.append(time.perf_counter() - t0)
                 rate = batch / min(times)
                 pal["per_batch"][batch] = {
@@ -178,7 +181,9 @@ def _measure() -> dict:
             rates = []
             for _ in range(3):
                 t0 = time.perf_counter()
-                jax.block_until_ready([fn(*args) for _ in range(depth)])
+                outs = [fn(*args) for _ in range(depth)]
+                for o in outs:
+                    np.asarray(o)  # true sync: D2H readback per batch
                 rates.append(depth * best_batch / (time.perf_counter() - t0))
             pipeline[depth] = round(max(rates), 1)
         pipe_best = max(pipeline.values())

@@ -102,12 +102,33 @@ async def _run_cluster(n_clients, keys_per_client, sweeps, verifier, factory, se
         t0 = time.perf_counter()
         await asyncio.gather(*[worker(i) for i in range(n_clients)])
         wall = time.perf_counter() - t0
+        # capture before __aexit__ clears vc.replicas; mean over replicas so
+        # the number reads as "one replica's crypto share of its CPU time"
+        # (the 5 in-process replicas share one wall clock — summing would
+        # report a 5-replica aggregate that can exceed 100%)
+        crypto_s = (
+            sum(
+                r.metrics.timers["replica.crypto-local"].total_seconds
+                for r in vc.replicas
+            )
+            / len(vc.replicas)
+            if vc.replicas
+            else None
+        )
 
+    # BASELINE.json target "<5% replica CPU in crypto": one replica's
+    # synchronous crypto time (session MACs, grant/envelope Ed25519 signs
+    # — the "replica.crypto-local" timer), averaged over replicas, as a
+    # share of the run's wall clock.  Ed25519 *verification* rides the
+    # shared verifier service, so it never lands on a replica's own CPU.
     rec = {
         "metric": "signed_txn_throughput_5replica_f1",
         "value": round(ops / wall, 1),
         "unit": "txns/sec",
         "verifier": verifier,
+        "replica_crypto_cpu_pct_of_wall_mean": (
+            round(100.0 * crypto_s / wall, 2) if crypto_s is not None else None
+        ),
         "read_p50_ms": round(_pct(read_lat, 0.50) * 1e3, 2),
         "read_p95_ms": round(_pct(read_lat, 0.95) * 1e3, 2),
         "write_p50_ms": round(_pct(write_lat, 0.50) * 1e3, 2),

@@ -14,6 +14,7 @@ from typing import Dict, List
 
 def run(batch_sizes=(1024, 2048, 4096, 8192), iters: int = 3) -> Dict:
     import jax
+    import numpy as np
 
     from mochi_tpu.crypto import batch_verify, keys
     from mochi_tpu.crypto.curve import verify_prepared
@@ -38,8 +39,11 @@ def run(batch_sizes=(1024, 2048, 4096, 8192), iters: int = 3) -> Dict:
         best = float("inf")
         for _ in range(iters):
             t0 = time.perf_counter()
-            out = jax.block_until_ready(fn(*args))
+            # np.asarray forces D2H readback — the only reliable sync through
+            # the axon relay (block_until_ready can return pre-completion)
+            out = np.asarray(fn(*args))
             best = min(best, time.perf_counter() - t0)
+        assert out.all()
         points.append(
             {"batch": b, "sigs_per_sec": round(b / best, 1), "ms": round(best * 1e3, 2)}
         )

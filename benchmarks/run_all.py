@@ -85,8 +85,20 @@ def main(argv) -> None:
     if publish:
         round_n = os.environ.get("MOCHI_BENCH_ROUND", "02")
         out_path = os.path.join(_REPO, "benchmarks", f"results_r{round_n}.json")
+        # merge by config key — a partial invocation (e.g. "run_all 1 2")
+        # must not clobber the other configs' records in the results file
+        merged = {}
+        if os.path.exists(out_path):
+            try:
+                with open(out_path) as fh:
+                    merged = {r.get("config"): r for r in json.load(fh)}
+            except (ValueError, OSError):
+                merged = {}
+        merged.update({r.get("config"): r for r in results})
         with open(out_path, "w") as fh:
-            json.dump(results, fh, indent=2)
+            json.dump(
+                [merged[k] for k in sorted(merged, key=str)], fh, indent=2
+            )
         baseline_path = os.path.join(_REPO, "BASELINE.json")
         with open(baseline_path) as fh:
             baseline = json.load(fh)

@@ -88,6 +88,7 @@ class MochiDBClient:
         # fallback (and the handshake carrier) — crypto/session.py.
         self._sessions: Dict[str, bytes] = {}
         self._session_locks: Dict[str, asyncio.Lock] = {}
+        self._read_rotor = 0
 
     # ------------------------------------------------------------ plumbing
 
@@ -98,6 +99,36 @@ class MochiDBClient:
             for info in self.config.servers_for_key(key):
                 seen[info.server_id] = info
         return sorted(seen.items())
+
+    def _quorum_targets(self, transaction: Transaction) -> List[Tuple[str, ServerInfo]]:
+        """A minimal read fan-out: greedily cover every key's replica set
+        with exactly ``quorum`` members (rotating the start point to spread
+        load).  Reads only need 2f+1 matching answers, so fanning to all
+        3f+1 replicas sends f extra requests per key that the tally then
+        ignores — the reference always fans to the full union
+        (``MochiDBClient.java:120-125``); the paper's own read bound is even
+        lower (f+1, ``mochiDB.tex:142``).  A trimmed read can fail
+        spuriously (a chosen replica lagging a just-committed write), so
+        :meth:`_read_once` falls back to the full union before giving up.
+        """
+        q = self.config.quorum
+        chosen: Dict[str, ServerInfo] = {}
+        self._read_rotor += 1
+        for key in transaction.keys:
+            rset = self.config.servers_for_key(key)
+            have = sum(1 for info in rset if info.server_id in chosen)
+            if have >= q:
+                continue
+            n = len(rset)
+            start = self._read_rotor % n
+            for off in range(n):
+                if have >= q:
+                    break
+                info = rset[(start + off) % n]
+                if info.server_id not in chosen:
+                    chosen[info.server_id] = info
+                    have += 1
+        return sorted(chosen.items())
 
     @staticmethod
     def _is_admin_txn(transaction: Transaction) -> bool:
@@ -199,10 +230,15 @@ class MochiDBClient:
             )
 
     async def _fan_out(
-        self, transaction: Transaction, payload_factory, _retry: bool = True
+        self,
+        transaction: Transaction,
+        payload_factory,
+        _retry: bool = True,
+        targets: Optional[List[Tuple[str, ServerInfo]]] = None,
     ) -> Dict[str, object]:
         """Fan a payload to the replica set; keep only authentic responses."""
-        targets = self._targets(transaction)
+        if targets is None:
+            targets = self._targets(transaction)
         missing = [t for t in targets if t[0] not in self._sessions]
         if missing:  # skip coroutine+gather setup on the steady-state path
             await asyncio.gather(
@@ -236,7 +272,9 @@ class MochiDBClient:
         if stale_sessions and _retry:
             for sid in stale_sessions:
                 self._sessions.pop(sid, None)
-            return await self._fan_out(transaction, payload_factory, _retry=False)
+            return await self._fan_out(
+                transaction, payload_factory, _retry=False, targets=targets
+            )
         return out
 
     async def close(self) -> None:
@@ -253,19 +291,28 @@ class MochiDBClient:
         config if there is one and retry once.
         """
         try:
-            return await self._read_once(transaction)
+            try:
+                return await self._read_once(transaction, trim=True)
+            except InconsistentRead:
+                # The quorum-sized fan-out can miss when a chosen replica
+                # lags a fresh commit or times out — the full union is the
+                # authoritative attempt.
+                return await self._read_once(transaction, trim=False)
         except InconsistentRead:
             if transaction.keys == (CONFIG_CLUSTER_KEY,) or not await self.refresh_config():
                 raise
-            return await self._read_once(transaction)
+            return await self._read_once(transaction, trim=False)
 
-    async def _read_once(self, transaction: Transaction) -> TransactionResult:
+    async def _read_once(
+        self, transaction: Transaction, trim: bool = False
+    ) -> TransactionResult:
         with self.metrics.timer("read-transactions"):
             nonce = new_msg_id()
             with self.metrics.timer("read-transactions-step1-future-wait"):
                 responses = await self._fan_out(
                     transaction,
                     lambda: ReadToServer(self.client_id, transaction, nonce),
+                    targets=self._quorum_targets(transaction) if trim else None,
                 )
             reads = {
                 sid: p

@@ -268,8 +268,25 @@ class JaxBatchBackend:
     timeouts; see the batching discipline in SURVEY.md §7).
     """
 
-    def __init__(self, device: Optional[jax.Device] = None):
+    def __init__(
+        self,
+        device: Optional[jax.Device] = None,
+        min_device_items: Optional[int] = None,
+    ):
         self.device = device
+        # CPU/device crossover: a device launch costs a fixed round trip
+        # (~100 ms through the axon tunnel; ~1 ms on an attached chip),
+        # while OpenSSL verifies ~0.18 ms/sig on this host — so batches
+        # below the crossover are faster (and lower-latency for the
+        # cluster's commit path) on CPU.  Tunable: MOCHI_DEVICE_MIN_BATCH.
+        if min_device_items is None:
+            try:
+                min_device_items = int(
+                    os.environ.get("MOCHI_DEVICE_MIN_BATCH", "384")
+                )
+            except ValueError:
+                min_device_items = 384
+        self.min_device_items = max(0, min_device_items)
         self._ready: set[int] = set()
         self._compiling: set[int] = set()
         self._failed: set[int] = set()
@@ -305,6 +322,13 @@ class JaxBatchBackend:
         threading.Thread(target=run, name=f"verify-warm-{bucket}", daemon=True).start()
 
     def __call__(self, items: Sequence[VerifyItem]) -> Sequence[bool]:
+        if len(items) < self.min_device_items:
+            from . import keys as _keys
+
+            return [
+                _keys.verify(it.public_key, it.message, it.signature)
+                for it in items
+            ]
         bucket = _bucket_size(len(items))
         with self._lock:
             ready_now = bucket in self._ready

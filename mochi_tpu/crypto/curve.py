@@ -45,6 +45,11 @@ from . import field as F
 # unrolled additions instead of a fori_loop of dynamic updates.
 MOSAIC_SAFE = False
 
+# Ladder fori_loop unroll factor (1 = loop 64 window bodies; higher trades
+# compile time for a larger per-iteration fusion scope on the VPU).
+# Measured on v5e with scripts/unroll_bench.py before changing.
+LADDER_UNROLL = 1
+
 
 class Point(NamedTuple):
     """Extended coordinates (X : Y : Z : T), x=X/Z, y=Y/Z, T=XY/Z.
@@ -340,7 +345,7 @@ def double_scalar_mul_windowed(
         nxy2d = F.select(sn, F.neg(nxy2d), nxy2d)
         return tuple(madd_niels(q, nypx, nymx, nxy2d))
 
-    q = lax.fori_loop(0, 64, body, tuple(identity(lanes)))
+    q = lax.fori_loop(0, 64, body, tuple(identity(lanes)), unroll=LADDER_UNROLL)
     return Point(*q)
 
 

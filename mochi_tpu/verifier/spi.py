@@ -72,27 +72,73 @@ class CachingVerifier(SignatureVerifier):
         self.inner = inner
         self.max_entries = max_entries
         self._cache: "dict[Tuple[bytes, bytes, bytes], bool]" = {}
+        # single-flight: key -> future for a verification already dispatched
+        # but not yet answered.  All rf replicas of a set check the same
+        # certificate within one batching window, so without this the
+        # duplicates race past the cache (observed: 0 service cache hits
+        # under concurrent cluster load) and each costs a real verification.
+        self._inflight: "dict[Tuple[bytes, bytes, bytes], asyncio.Future]" = {}
         self.hits = 0
         self.misses = 0
 
     async def verify_batch(self, items: Sequence[VerifyItem]) -> List[bool]:
-        out: List[Optional[bool]] = []
-        missing: List[Tuple[int, VerifyItem]] = []
+        out: List[Optional[bool]] = [None] * len(items)
+        waiting: List[Tuple[int, asyncio.Future]] = []
+        new_keys: "dict[Tuple[bytes, bytes, bytes], List[int]]" = {}
+        reps: List[VerifyItem] = []
         for i, it in enumerate(items):
-            cached = self._cache.get((it.public_key, it.message, it.signature))
-            out.append(cached)
-            if cached is None:
-                missing.append((i, it))
-        self.hits += len(items) - len(missing)
-        self.misses += len(missing)
-        if missing:
-            bitmap = await self.inner.verify_batch([it for _, it in missing])
-            for (i, it), ok in zip(missing, bitmap):
-                out[i] = bool(ok)
+            k = (bytes(it.public_key), bytes(it.message), bytes(it.signature))
+            cached = self._cache.get(k)
+            if cached is not None:
+                out[i] = cached
+                self.hits += 1
+            elif k in self._inflight:
+                waiting.append((i, self._inflight[k]))
+                self.hits += 1
+            elif k in new_keys:
+                new_keys[k].append(i)
+                self.hits += 1
+            else:
+                new_keys[k] = [i]
+                reps.append(it)
+                self.misses += 1
+        if new_keys:
+            loop = asyncio.get_running_loop()
+            futs = {k: loop.create_future() for k in new_keys}
+            self._inflight.update(futs)
+            try:
+                bitmap = await self.inner.verify_batch(reps)
+            except BaseException:
+                # Dispatch failed (or owner cancelled): resolve the futures
+                # with a retry sentinel rather than an exception — a
+                # concurrent waiter must not inherit THIS caller's failure
+                # (it would have verified independently before single-flight
+                # existed), and a sentinel can't trigger "exception never
+                # retrieved" warnings when nobody is waiting.
+                for k, fut in futs.items():
+                    self._inflight.pop(k, None)
+                    if not fut.done():
+                        fut.set_result(None)
+                raise
+            for (k, idxs), ok in zip(new_keys.items(), bitmap):
+                ok = bool(ok)
+                for i in idxs:
+                    out[i] = ok
                 if len(self._cache) >= self.max_entries:
                     # drop the oldest insertion (dict preserves order)
                     self._cache.pop(next(iter(self._cache)))
-                self._cache[(it.public_key, it.message, it.signature)] = bool(ok)
+                self._cache[k] = ok
+                fut = futs[k]
+                self._inflight.pop(k, None)
+                if not fut.done():
+                    fut.set_result(ok)
+        for i, fut in waiting:
+            ok = await fut
+            if ok is None:
+                # the dispatching caller failed before producing a verdict —
+                # verify this item ourselves (re-enters cache/single-flight)
+                (ok,) = await self.verify_batch([items[i]])
+            out[i] = bool(ok)
         return [bool(b) for b in out]
 
     async def close(self) -> None:

@@ -48,23 +48,25 @@ def main():
         out = jax.block_until_ready(fn(*args))
         assert np.asarray(out).all()
 
-        # sequential (bench.py's method)
+        # sequential (bench.py's method).  np.asarray = D2H readback, the
+        # only reliable sync through the axon relay (block_until_ready can
+        # return pre-completion and yield absurd rates).
         times = []
         for _ in range(4):
             t0 = time.perf_counter()
-            jax.block_until_ready(fn(*args))
+            np.asarray(fn(*args))
             times.append(time.perf_counter() - t0)
         seq = batch / min(times)
 
         # pipelined at depth D
         for depth in (2, 4, 8):
             t0 = time.perf_counter()
-            outs = [fn(*args) for _ in range(depth)]
-            jax.block_until_ready(outs)
+            for o in [fn(*args) for _ in range(depth)]:
+                np.asarray(o)
             warm = time.perf_counter() - t0  # first window includes ramp
             t0 = time.perf_counter()
-            outs = [fn(*args) for _ in range(depth)]
-            jax.block_until_ready(outs)
+            for o in [fn(*args) for _ in range(depth)]:
+                np.asarray(o)
             dt = time.perf_counter() - t0
             rate = depth * batch / dt
             print(

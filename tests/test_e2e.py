@@ -202,3 +202,39 @@ def test_metrics_recorded():
             assert server_snap["timers"]["replica.write1"]["count"] >= 1
 
     run(main())
+
+
+def test_quorum_targets_cover_every_key():
+    """The trimmed read fan-out must give every key >= quorum members of its
+    own replica set, never exceed the union, and rotate across calls."""
+    from mochi_tpu.client import MochiDBClient
+    from mochi_tpu.client.txn import TransactionBuilder
+    from mochi_tpu.cluster.config import ClusterConfig
+
+    cfg = ClusterConfig.build(
+        {f"server-{i}": f"127.0.0.1:{9300 + i}" for i in range(7)}, rf=4
+    )
+    client = MochiDBClient(cfg)
+    tb = TransactionBuilder()
+    for i in range(6):
+        tb.read(f"qt-key-{i}")
+    txn = tb.build()
+    full = dict(client._targets(txn))
+    picks = set()
+    for _ in range(8):
+        chosen = dict(client._quorum_targets(txn))
+        assert set(chosen) <= set(full)
+        for op in txn.operations:
+            rset = {s.server_id for s in cfg.servers_for_key(op.key)}
+            assert len(rset & set(chosen)) >= cfg.quorum, op.key
+        picks.add(tuple(sorted(chosen)))
+    # single-key: exactly quorum-many targets, and the rotor varies them
+    single = TransactionBuilder().read("qt-single").build()
+    sizes = set()
+    singles = set()
+    for _ in range(8):
+        chosen = client._quorum_targets(single)
+        sizes.add(len(chosen))
+        singles.add(tuple(sorted(sid for sid, _ in chosen)))
+    assert sizes == {cfg.quorum}
+    assert len(singles) > 1, "rotor never varied the chosen quorum"

@@ -163,3 +163,40 @@ def test_batcher_close_while_flusher_awaits_inflight_slot():
         assert hung == 0, f"{hung} verify_batch callers hung after close()"
 
     asyncio.run(main())
+
+
+def test_caching_verifier_waiter_survives_dispatcher_failure():
+    """Single-flight: if the caller that dispatched a key fails (error or
+    cancellation), a concurrent waiter on that key must re-verify
+    independently, not inherit the dispatcher's failure (round-2 review)."""
+    import asyncio
+
+    from mochi_tpu.crypto import keys
+    from mochi_tpu.verifier.spi import CachingVerifier, SignatureVerifier, VerifyItem
+
+    class FlakyVerifier(SignatureVerifier):
+        def __init__(self):
+            self.calls = 0
+
+        async def verify_batch(self, items):
+            self.calls += 1
+            await asyncio.sleep(0.05)
+            if self.calls == 1:
+                raise RuntimeError("first dispatch dies")
+            return [
+                keys.verify(it.public_key, it.message, it.signature)
+                for it in items
+            ]
+
+    async def main():
+        cv = CachingVerifier(FlakyVerifier())
+        kp = keys.generate_keypair()
+        it = VerifyItem(kp.public_key, b"g", kp.sign(b"g"))
+        t1 = asyncio.create_task(cv.verify_batch([it]))
+        await asyncio.sleep(0.01)
+        t2 = asyncio.create_task(cv.verify_batch([it]))
+        (r1,) = await asyncio.gather(t1, return_exceptions=True)
+        assert isinstance(r1, RuntimeError)
+        assert await t2 == [True]
+
+    asyncio.run(main())

@@ -50,7 +50,7 @@ def test_valid_signatures_still_pass_both_paths():
 def test_backend_chunks_use_only_ready_buckets(monkeypatch):
     """A batch whose own bucket isn't compiled must be served only through
     already-ready program shapes (no synchronous compile on the serving path)."""
-    backend = batch_verify.JaxBatchBackend()
+    backend = batch_verify.JaxBatchBackend(min_device_items=0)  # force the device path: these tests pin bucket/chunk behavior
     backend._ready = {16, 128}
     # mark bucket 64 as already compiling so no background warmup thread is
     # spawned — we only want to observe the serving path's launches
@@ -74,7 +74,7 @@ def test_backend_chunks_use_only_ready_buckets(monkeypatch):
 
 
 def test_failed_bucket_not_rescheduled():
-    backend = batch_verify.JaxBatchBackend()
+    backend = batch_verify.JaxBatchBackend(min_device_items=0)  # force the device path: these tests pin bucket/chunk behavior
     backend._ready = {16}
     backend._failed = {64}
     kp = keys.generate_keypair()
