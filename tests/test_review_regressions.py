@@ -181,7 +181,7 @@ def test_read_tally_ignores_out_of_set_servers():
     client = MochiDBClient(cfg)
     txn = TransactionBuilder().read(key).build()
 
-    async def fake_fan_out(transaction, make_payload):
+    async def fake_fan_out(transaction, make_payload, targets=None, **kw):
         payload = make_payload()
         nonce = payload.nonce
         honest = TransactionResult((OperationResult(b"good", None, True, Status.OK),))
