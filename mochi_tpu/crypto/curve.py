@@ -371,6 +371,10 @@ def verify_core(
     (:func:`verify_prepared`) and the Pallas kernel
     (:mod:`mochi_tpu.crypto.pallas_verify`).
     """
+    # (Measured and rejected: fusing the A/R decompressions into one
+    # (17, 2B) call to halve the pow_p58 sequential depth — 108.7k vs
+    # ~110k sigs/s at batch 8192 depth-8; the doubled lane width during
+    # decompress cancels the depth win at the production bucket size.)
     a_point, ok_a = decompress(y_a, sign_a)
     r_point, ok_r = decompress(y_r, sign_r)
     q = double_scalar_mul_windowed(s_dig, h_dig, negate(a_point), b_tab=b_tab)

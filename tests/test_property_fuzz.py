@@ -153,3 +153,94 @@ def test_recode_signed4_exact_over_random_scalars():
             d = int(mag[k, i]) * (-1 if neg[k, i] else 1)
             acc += d * (16**k)
         assert acc == s, (i, s)
+
+
+def test_vectorized_prepare_matches_per_item_reference():
+    """The numpy-vectorized ``batch_verify.prepare`` must agree with a
+    straightforward per-item reference on pre_ok and on every tensor row
+    where pre_ok holds (rejected lanes are don't-care: the device bitmap
+    is masked by pre_ok).  Coverage includes malformed lengths, the
+    y >= p and S >= L canonicity boundaries, the x-parity bit, and
+    random garbage."""
+    import hashlib
+
+    import numpy as np
+
+    from mochi_tpu.crypto import batch_verify as bv, field as F, keys
+    from mochi_tpu.verifier.spi import VerifyItem
+
+    def prepare_ref(items):
+        n = len(items)
+        y_a = np.zeros((n, F.NLIMBS), np.int32)
+        y_r = np.zeros((n, F.NLIMBS), np.int32)
+        sign_a = np.zeros(n, np.int32)
+        sign_r = np.zeros(n, np.int32)
+        s_bits = np.zeros((n, 256), np.int32)
+        h_bits = np.zeros((n, 256), np.int32)
+        pre_ok = np.zeros(n, bool)
+        for i, it in enumerate(items):
+            if len(it.public_key) != 32 or len(it.signature) != 64:
+                continue
+            a = bytes(it.public_key)
+            r = bytes(it.signature[:32])
+            s = int.from_bytes(it.signature[32:], "little")
+            ya = int.from_bytes(a, "little") & ((1 << 255) - 1)
+            yr = int.from_bytes(r, "little") & ((1 << 255) - 1)
+            if ya >= F.P_INT or yr >= F.P_INT or s >= F.L_INT:
+                continue
+            h = (
+                int.from_bytes(
+                    hashlib.sha512(r + a + bytes(it.message)).digest(), "little"
+                )
+                % F.L_INT
+            )
+            y_a[i] = F.int_to_limbs(ya)
+            y_r[i] = F.int_to_limbs(yr)
+            sign_a[i] = a[31] >> 7
+            sign_r[i] = r[31] >> 7
+            s_bits[i] = np.unpackbits(
+                np.frombuffer(s.to_bytes(32, "little"), np.uint8),
+                bitorder="little",
+            )
+            h_bits[i] = np.unpackbits(
+                np.frombuffer(h.to_bytes(32, "little"), np.uint8),
+                bitorder="little",
+            )
+            pre_ok[i] = True
+        return y_a, sign_a, y_r, sign_r, s_bits, h_bits, pre_ok
+
+    rng = np.random.default_rng(0xF00D)
+    kp = keys.generate_keypair()
+    P, L = F.P_INT, F.L_INT
+
+    def enc(v, hi=0):
+        return (v | (hi << 255)).to_bytes(32, "little")
+
+    items = [
+        VerifyItem(kp.public_key, b"m%d" % i, kp.sign(b"m%d" % i))
+        for i in range(40)
+    ]
+    items += [
+        VerifyItem(b"short", b"m", kp.sign(b"m")),
+        VerifyItem(kp.public_key, b"m", b"tiny"),
+        VerifyItem(b"", b"", b""),
+    ]
+    for ya in (P - 1, P, P + 1, (1 << 255) - 1, 0, 19):
+        for hi in (0, 1):
+            items.append(VerifyItem(enc(ya, hi), b"x", kp.sign(b"x")))
+    for sval in (L - 1, L, L + 1, (1 << 256) - 1, 0):
+        sig = kp.sign(b"y")[:32] + (sval % (1 << 256)).to_bytes(32, "little")
+        items.append(VerifyItem(kp.public_key, b"y", sig))
+    for yr in (P - 1, P, P + 19):
+        items.append(
+            VerifyItem(kp.public_key, b"z", enc(yr) + kp.sign(b"z")[32:])
+        )
+    for _ in range(60):
+        items.append(VerifyItem(rng.bytes(32), rng.bytes(8), rng.bytes(64)))
+
+    ref = prepare_ref(items)
+    got = bv.prepare(items)
+    assert np.array_equal(ref[6], got[6]), "pre_ok diverged"
+    ok = ref[6]
+    for k in range(6):
+        assert np.array_equal(ref[k][ok], got[k][ok]), k
