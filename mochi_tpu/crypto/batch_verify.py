@@ -5,10 +5,15 @@ Division of labor (the TPU-first design, SURVEY.md §7):
 * **Host** — everything variable-length or trivially cheap: SHA-512 of
   R||A||M (hashlib -> OpenSSL C, ~GB/s), the mod-L scalar reduction
   (python bignum), RFC 8032 canonical-encoding prechecks (y < p, S < L),
-  and packing into fixed-shape int32 tensors.
+  and packing into fixed-shape tensors (scalars as bytes — 32x less H2D
+  transfer than bit tensors; the device unpacks).
 * **Device** — all the modular heavy lifting (~4400 field muls per
   signature): point decompression (two fixed exponentiation chains) and the
-  256-step double-scalar-mul, batched over the leading axis.
+  256-step double-scalar-mul, batched over the leading axis.  Oversized
+  requests chunk at MAX_BUCKET with every chunk launched before any is
+  read back, so chunk k+1's host prepare and transfer overlap chunk k's
+  device execution (measured end-to-end on 64k items: 19.1k sequential ->
+  63k sigs/s pipelined+packed).
 
 Batches are padded to power-of-two buckets so XLA compiles a handful of
 program shapes, then caches (SURVEY.md §7: static shapes; first compile
@@ -125,22 +130,34 @@ def _lt_l(words: np.ndarray) -> np.ndarray:
 
 
 def prepare(items: Sequence[VerifyItem]):
-    """Host-side packing: items -> fixed-shape numpy tensors + precheck bitmap.
+    """Host-side packing: items -> fixed-shape numpy tensors + precheck
+    bitmap, scalars as (n, 256) int32 bit tensors (the
+    :func:`~mochi_tpu.crypto.curve.verify_prepared` input format)."""
+    y_a, sign_a, y_r, sign_r, s_bytes, h_bytes, pre_ok = prepare_packed(items)
+    s_bits = np.unpackbits(s_bytes, axis=1, bitorder="little").astype(np.int32)
+    h_bits = np.unpackbits(h_bytes, axis=1, bitorder="little").astype(np.int32)
+    return y_a, sign_a, y_r, sign_r, s_bits, h_bits, pre_ok
+
+
+def prepare_packed(items: Sequence[VerifyItem]):
+    """Host-side packing with scalars as (n, 32) uint8 LE bytes.
 
     Vectorized over the batch (numpy byte/bit ops; the only per-item Python
-    is SHA-512 — hashlib's C — and the mod-L bignum): ~8 us/item vs the
+    is SHA-512 — hashlib's C — and the mod-L bignum): ~6 us/item vs the
     round-2a per-item loop's ~114 us/item, which capped the end-to-end
     service at ~9k items/s in front of a >100k items/s device pipeline.
-    Semantics unchanged: malformed lengths, non-canonical y (>= p) and
-    S >= L are rejected on host exactly as RFC 8032 decode / OpenSSL do.
+    The byte (not bit) scalar form keeps the host->device transfer 32x
+    smaller (device unpacks — curve.verify_prepared_packed).
+    Semantics: malformed lengths, non-canonical y (>= p) and S >= L are
+    rejected on host exactly as RFC 8032 decode / OpenSSL do.
     """
     n = len(items)
     y_a = np.zeros((n, F.NLIMBS), dtype=np.int32)
     y_r = np.zeros((n, F.NLIMBS), dtype=np.int32)
     sign_a = np.zeros(n, dtype=np.int32)
     sign_r = np.zeros(n, dtype=np.int32)
-    s_bits = np.zeros((n, 256), dtype=np.int32)
-    h_bits = np.zeros((n, 256), dtype=np.int32)
+    s_bytes = np.zeros((n, 32), dtype=np.uint8)
+    h_bytes = np.zeros((n, 32), dtype=np.uint8)
     pre_ok = np.zeros(n, dtype=bool)
 
     idx = [
@@ -149,7 +166,7 @@ def prepare(items: Sequence[VerifyItem]):
         if len(it.public_key) == 32 and len(it.signature) == 64
     ]
     if not idx:
-        return y_a, sign_a, y_r, sign_r, s_bits, h_bits, pre_ok
+        return y_a, sign_a, y_r, sign_r, s_bytes, h_bytes, pre_ok
     m = len(idx)
 
     a_rows = np.frombuffer(
@@ -197,18 +214,19 @@ def prepare(items: Sequence[VerifyItem]):
         h_parts.append(h_int.to_bytes(32, "little"))
     if h_parts:
         h_rows = np.frombuffer(b"".join(h_parts), dtype=np.uint8).reshape(-1, 32)
-        h_bits[ok_idx] = _bits_le(h_rows)
+        h_bytes[ok_idx] = h_rows
 
     y_a[idx_arr] = _bits_to_limbs(a_bits)
     y_r[idx_arr] = _bits_to_limbs(r_bits)
     sign_a[idx_arr] = sa
     sign_r[idx_arr] = sr
-    s_bits[idx_arr] = _bits_le(s_rows)
+    s_bytes[idx_arr] = s_rows
     pre_ok[idx_arr] = ok
-    return y_a, sign_a, y_r, sign_r, s_bits, h_bits, pre_ok
+    return y_a, sign_a, y_r, sign_r, s_bytes, h_bytes, pre_ok
 
 
 _verify_jit = jax.jit(curve.verify_prepared)
+_verify_packed_jit = jax.jit(curve.verify_prepared_packed)
 
 
 def verify_batch(
@@ -226,11 +244,56 @@ def verify_batch(
     if not items:
         return []
     if len(items) > MAX_BUCKET and bucket is None:
+        # Pipeline the chunks behind a bounded window: launch up to
+        # _PIPELINE_DEPTH chunks before reading the oldest back, so chunk
+        # k+1's host prepare and transfer overlap chunk k's device
+        # execution (JAX dispatch is async) while live memory stays
+        # O(depth * MAX_BUCKET) instead of O(request).  Sequential
+        # chunking measured 19.1k sigs/s end-to-end on 64k items;
+        # pipelined+packed reaches ~70k (config-2 artifact).
+        from collections import deque
+
+        window: deque = deque()
         out: List[bool] = []
         for i in range(0, len(items), MAX_BUCKET):
-            out.extend(verify_batch(items[i : i + MAX_BUCKET], device=device))
+            chunk = items[i : i + MAX_BUCKET]
+            window.append((_launch(chunk, device), len(chunk)))
+            if len(window) >= _PIPELINE_DEPTH:
+                out.extend(_readback(*window.popleft()))
+        while window:
+            out.extend(_readback(*window.popleft()))
         return out
-    y_a, sign_a, y_r, sign_r, s_bits, h_bits, pre_ok = prepare(items)
+    return _readback((_launch(items, device, bucket)), len(items))
+
+
+# Bounded launch-ahead for the chunked path (see verify_batch).
+_PIPELINE_DEPTH = 4
+
+
+def _readback(launched, n: int) -> List[bool]:
+    """Block on one launched chunk and combine with its host prechecks."""
+    bitmap_dev, pre_ok = launched
+    bitmap = np.asarray(bitmap_dev)[:n]
+    return [bool(b) for b in np.logical_and(bitmap, pre_ok)]
+
+
+def _launch(
+    items: Sequence[VerifyItem],
+    device: Optional[jax.Device] = None,
+    bucket: Optional[int] = None,
+):
+    """Prepare, pad and DISPATCH one chunk; no result readback.
+
+    Returns ``(device_bitmap, pre_ok)`` — the caller reads the bitmap back
+    with ``np.asarray`` when it needs the verdicts, which is what lets
+    multiple chunks pipeline on the device.  Scalars travel as packed
+    bytes (32x smaller H2D transfer; the device unpacks).
+    """
+    if _impl() == "pallas":
+        # The (shelved) Pallas kernel consumes the bit-tensor format.
+        y_a, sign_a, y_r, sign_r, s_bits, h_bits, pre_ok = prepare(items)
+    else:
+        y_a, sign_a, y_r, sign_r, s_bits, h_bits, pre_ok = prepare_packed(items)
     n = len(items)
     m = _bucket_size(n) if bucket is None else bucket
     assert m >= n
@@ -248,10 +311,8 @@ def verify_batch(
     if _impl() == "pallas":
         from . import pallas_verify
 
-        bitmap = np.asarray(pallas_verify.verify_prepared_pallas(*args))[:n]
-    else:
-        bitmap = np.asarray(_verify_jit(*args))[:n]
-    return [bool(b) for b in np.logical_and(bitmap, pre_ok)]
+        return pallas_verify.verify_prepared_pallas(*args), pre_ok
+    return _verify_packed_jit(*args), pre_ok
 
 
 class JaxBatchBackend:

@@ -39,13 +39,18 @@ class TpuBatchVerifier(BatchingVerifier):
         max_delay_s: float = 0.002,
         fallback: Optional[SignatureVerifier] = None,
         warmup_buckets: Sequence[int] = (),
+        min_device_items: Optional[int] = None,
+        max_inflight: int = 4,
     ):
-        jax_backend = JaxBatchBackend(device=device)
+        jax_backend = JaxBatchBackend(
+            device=device, min_device_items=min_device_items
+        )
         super().__init__(
             backend=jax_backend,
             max_batch=max_batch,
             max_delay_s=max_delay_s,
             fallback=fallback,
+            max_inflight=max_inflight,
         )
         if warmup_buckets:
             jax_backend.warmup(warmup_buckets)
