@@ -13,7 +13,7 @@ Division of labor (the TPU-first design, SURVEY.md §7):
   requests chunk at MAX_BUCKET with every chunk launched before any is
   read back, so chunk k+1's host prepare and transfer overlap chunk k's
   device execution (measured end-to-end on 64k items: 19.1k sequential ->
-  63k sigs/s pipelined+packed).
+  69.8k sigs/s pipelined+packed, config-2 artifact).
 
 Batches are padded to power-of-two buckets so XLA compiles a handful of
 program shapes, then caches (SURVEY.md §7: static shapes; first compile
@@ -45,12 +45,13 @@ LOG = logging.getLogger(__name__)
 
 MIN_BUCKET = 16
 # Largest single device launch.  Measured on v5e (bench.py, round 2): 8192
-# lanes is the throughput peak (63.6k sigs/s) after the signed-window
-# ladder halved the per-item small-multiples tables and the pad-skew
-# multiply removed the HBM-streaming intermediates; 16384 still spills
-# VMEM and runs ~15% slower, 4096 underfills (42.5k).  Bigger requests are
-# chunked at this size, so rate stays flat instead of regressing.  Tune
-# via MOCHI_MAX_BUCKET without a code change.
+# lanes is the throughput peak (91k sigs/s sequential, 111k with 4 batches
+# in flight) after the signed-window ladder halved the per-item
+# small-multiples tables and the pad-skew multiply removed the
+# HBM-streaming intermediates; 16384 still spills VMEM, 4096 underfills.
+# Bigger requests are chunked at this size behind a bounded launch window,
+# so rate stays flat instead of regressing.  Tune via MOCHI_MAX_BUCKET
+# without a code change.
 def _max_bucket() -> int:
     """MOCHI_MAX_BUCKET, sanitized: >= MIN_BUCKET and a power of two (a
     non-power would chunk at sizes _bucket_size pads PAST the VMEM cap the

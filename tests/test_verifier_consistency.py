@@ -83,3 +83,30 @@ def test_failed_bucket_not_rescheduled():
     out = backend(items)
     assert list(out) == [True] * 40
     assert 64 not in backend._compiling
+
+
+def test_small_batches_take_the_cpu_crossover(monkeypatch):
+    """Below min_device_items the backend must verify on OpenSSL without
+    touching the device path (a device launch costs a fixed round trip
+    that would poison commit latency for thin traffic)."""
+    calls = []
+    real = batch_verify.verify_batch
+
+    def spy(items, device=None, bucket=None):
+        calls.append(len(items))
+        return real(items, device=device, bucket=bucket)
+
+    monkeypatch.setattr(batch_verify, "verify_batch", spy)
+    backend = batch_verify.JaxBatchBackend(min_device_items=64)
+    kp2 = keys.generate_keypair()
+    items = [VerifyItem(kp2.public_key, b"c%d" % i, kp2.sign(b"c%d" % i)) for i in range(20)]
+    bad = bytearray(items[4].signature)
+    bad[1] ^= 1
+    items[4] = VerifyItem(items[4].public_key, items[4].message, bytes(bad))
+    out = backend(items)
+    assert list(out) == [i != 4 for i in range(20)]
+    assert not calls, "device path was used below the crossover"
+    # at/above the threshold the device path engages
+    big = [VerifyItem(kp2.public_key, b"d%d" % i, kp2.sign(b"d%d" % i)) for i in range(64)]
+    assert all(backend(big))
+    assert calls, "device path not used at the crossover"
