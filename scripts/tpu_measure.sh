@@ -5,6 +5,9 @@
 # capture); this script grabs EVERYTHING in one sitting, cheapest-first,
 # so a partial run still leaves artifacts:
 #
+#   NOTE round-2 lesson: time device work ONLY with np.asarray readback in
+#   the timed region — the relay's block_until_ready can return before
+#   execution completes (verify_batch/bench.py already comply).
 #   1. liveness probe (watchdogged, throwaway subprocess)
 #   2. headline bench.py  -> BENCH-style JSON (+ per-batch table, MFU)
 #   3. MAX_BUCKET sweep   -> is 8192 the new peak post-signed-windows?
@@ -28,8 +31,8 @@ fi
 echo "== 2. headline bench" | tee -a "$OUT"
 timeout 2400 python bench.py | tee -a "$OUT"
 
-echo "== 3. MAX_BUCKET sweep (is 8192 the post-signed-window peak?)" | tee -a "$OUT"
-for mb in 4096 8192; do
+echo "== 3. MAX_BUCKET sweep (8192 was the round-2 peak; check 16384 post-packing)" | tee -a "$OUT"
+for mb in 8192 16384; do
   MOCHI_MAX_BUCKET=$mb timeout 900 python - <<'EOF' 2>&1 | tee -a "$OUT"
 import os, time, numpy as np, jax
 jax.config.update("jax_compilation_cache_dir", ".jax_cache")
