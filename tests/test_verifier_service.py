@@ -128,3 +128,54 @@ def test_cluster_routes_cert_checks_through_shared_service():
             await service.close()
 
     run(main())
+
+
+def test_cluster_survives_service_death_and_recovery():
+    """Kill the shared verifier service mid-traffic: replicas must fall
+    back to local CPU verification (availability degrades, safety holds),
+    and when a service returns on the same port they must resume routing
+    through it — each RemoteVerifier retries the remote path per batch."""
+
+    async def main():
+        service = VerifierService(port=0, verifier=CpuVerifier())
+        await service.start()
+        port = service.bound_port
+        async with VirtualCluster(
+            4, rf=4,
+            verifier_factory=lambda: RemoteVerifier("127.0.0.1", port),
+        ) as vc:
+            client = vc.client()
+            await client.execute_write_transaction(
+                TransactionBuilder().write("sd-1", b"a").build()
+            )
+            assert service.requests > 0
+
+            # service dies mid-run
+            await service.close()
+            await client.execute_write_transaction(
+                TransactionBuilder().write("sd-2", b"b").build()
+            )
+            res = await client.execute_read_transaction(
+                TransactionBuilder().read("sd-2").build()
+            )
+            assert res.operations[0].value == b"b"
+            assert any(
+                r.verifier.fallback_batches > 0 for r in vc.replicas
+            ), "no replica fell back while the service was down"
+
+            # a new service on the SAME port: replicas resume using it
+            service2 = VerifierService(port=port, verifier=CpuVerifier())
+            await service2.start()
+            try:
+                await client.execute_write_transaction(
+                    TransactionBuilder().write("sd-3", b"c").build()
+                )
+                res = await client.execute_read_transaction(
+                    TransactionBuilder().read("sd-3").build()
+                )
+                assert res.operations[0].value == b"c"
+                assert service2.requests > 0, "replicas never returned to the service"
+            finally:
+                await service2.close()
+
+    run(main())
