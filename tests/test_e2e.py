@@ -238,3 +238,26 @@ def test_quorum_targets_cover_every_key():
         singles.add(tuple(sorted(sid for sid, _ in chosen)))
     assert sizes == {cfg.quorum}
     assert len(singles) > 1, "rotor never varied the chosen quorum"
+
+
+def test_large_values_round_trip():
+    """Values up to the MB range ride the normal 2-phase path (frames cap
+    at 64 MiB); the acknowledged bytes must come back identical."""
+    import os as _os
+
+    async def main():
+        async with VirtualCluster(5, rf=4) as vc:
+            client = vc.client()
+            for size in (64 * 1024, 1024 * 1024):
+                blob = _os.urandom(size)
+                key = f"big-{size}"
+                await client.execute_write_transaction(
+                    TransactionBuilder().write(key, blob).build()
+                )
+                res = await client.execute_read_transaction(
+                    TransactionBuilder().read(key).build()
+                )
+                assert res.operations[0].value == blob, size
+            await client.close()
+
+    run(main())
