@@ -100,7 +100,7 @@ def run(n: int = 16, f: int = 5, n_ops: int = 2048, batch: int = 4096) -> Dict:
     bitmap, counts, committed = out
     assert bitmap[: len(items)].all()
 
-    return {
+    rec = {
         "metric": "ycsb_a_quorum_cert_aggregation",
         "value": round(n_groups / best, 1),
         "unit": "certs/sec",
@@ -112,6 +112,81 @@ def run(n: int = 16, f: int = 5, n_ops: int = 2048, batch: int = 4096) -> Dict:
         "sigs": len(items),
         "ms": round(best * 1e3, 2),
     }
+    try:
+        rec["cluster_ycsb_a"] = run_cluster_ycsb()
+    except Exception as exc:  # the device microbench result stands alone
+        rec["cluster_ycsb_a"] = {"error": f"{type(exc).__name__}: {exc}"[:200]}
+    return rec
+
+
+def run_cluster_ycsb(
+    n_clients: int = 5, n_ops_per_client: int = 60, n_keys: int = 64
+):
+    """YCSB-A through the REAL cluster: 50% reads / 50% updates over a
+    zipfian key distribution, 5 concurrent clients against a 5-replica
+    virtual cluster (rf=4, full signing).  Complements the device-side
+    aggregation microbench above with protocol-inclusive numbers."""
+    import asyncio
+    import time as _time
+
+    import numpy as np
+
+    from mochi_tpu.client.txn import TransactionBuilder
+    from mochi_tpu.testing.virtual_cluster import VirtualCluster
+
+    rng = np.random.default_rng(4242)
+
+    async def amain():
+        async with VirtualCluster(5, rf=4) as vc:
+            # preload the keyspace so reads hit existing keys
+            seed_client = vc.client()
+            for i in range(n_keys):
+                await seed_client.execute_write_transaction(
+                    TransactionBuilder().write(f"y-{i}", b"init").build()
+                )
+            read_lat: list = []
+            update_lat: list = []
+
+            async def worker(ci: int):
+                client = vc.client()
+                klist = _zipf_keys(rng, n_keys=n_keys, n_ops=n_ops_per_client)
+                for j, key in enumerate(klist):
+                    key = f"y-{key.split('-')[1]}"
+                    t0 = _time.perf_counter()
+                    if j % 2 == 0:
+                        await client.execute_write_transaction(
+                            TransactionBuilder().write(key, b"u%d-%d" % (ci, j)).build()
+                        )
+                        update_lat.append(_time.perf_counter() - t0)
+                    else:
+                        await client.execute_read_transaction(
+                            TransactionBuilder().read(key).build()
+                        )
+                        read_lat.append(_time.perf_counter() - t0)
+                await client.close()
+
+            t0 = _time.perf_counter()
+            await asyncio.gather(*[worker(i) for i in range(n_clients)])
+            wall = _time.perf_counter() - t0
+            await seed_client.close()
+
+            def pct(v, q):
+                s = sorted(v)
+                return round(s[min(len(s) - 1, int(q * len(s)))] * 1e3, 2)
+
+            ops = n_clients * n_ops_per_client
+            return {
+                "txn_s": round(ops / wall, 1),
+                "read_p50_ms": pct(read_lat, 0.5),
+                "read_p95_ms": pct(read_lat, 0.95),
+                "update_p50_ms": pct(update_lat, 0.5),
+                "update_p95_ms": pct(update_lat, 0.95),
+                "clients": n_clients,
+                "ops": ops,
+                "zipf_keys": n_keys,
+            }
+
+    return asyncio.run(amain())
 
 
 if __name__ == "__main__":
