@@ -16,7 +16,7 @@ import time
 from typing import Dict
 
 
-def _zipf_keys(rng, n_keys: int, n_ops: int, s: float = 0.99):
+def _zipf_keys(rng, n_keys: int, n_ops: int, s: float = 0.99, prefix: str = "key"):
     ranks = (
         rng.zipf(1.0 + s, size=n_ops * 2) - 1
     )  # oversample, clip to key space
@@ -25,7 +25,7 @@ def _zipf_keys(rng, n_keys: int, n_ops: int, s: float = 0.99):
         more = rng.zipf(1.0 + s, size=n_ops) - 1
         ranks = list(ranks) + list(more[more < n_keys])
         ranks = ranks[:n_ops]
-    return [f"key-{r}" for r in ranks]
+    return [f"{prefix}-{r}" for r in ranks]
 
 
 def run(n: int = 16, f: int = 5, n_ops: int = 2048, batch: int = 4096) -> Dict:
@@ -131,6 +131,7 @@ def run_cluster_ycsb(
 
     import numpy as np
 
+    from benchmarks.config1_cluster import _pct
     from mochi_tpu.client.txn import TransactionBuilder
     from mochi_tpu.testing.virtual_cluster import VirtualCluster
 
@@ -138,20 +139,24 @@ def run_cluster_ycsb(
 
     async def amain():
         async with VirtualCluster(5, rf=4) as vc:
-            # preload the keyspace so reads hit existing keys
+            # preload the keyspace so reads hit existing keys — batched
+            # into multi-write transactions (16 keys each) instead of 64
+            # sequential round trips of untimed setup
             seed_client = vc.client()
-            for i in range(n_keys):
-                await seed_client.execute_write_transaction(
-                    TransactionBuilder().write(f"y-{i}", b"init").build()
-                )
+            for base in range(0, n_keys, 16):
+                tb = TransactionBuilder()
+                for i in range(base, min(base + 16, n_keys)):
+                    tb.write(f"y-{i}", b"init")
+                await seed_client.execute_write_transaction(tb.build())
             read_lat: list = []
             update_lat: list = []
 
             async def worker(ci: int):
                 client = vc.client()
-                klist = _zipf_keys(rng, n_keys=n_keys, n_ops=n_ops_per_client)
+                klist = _zipf_keys(
+                    rng, n_keys=n_keys, n_ops=n_ops_per_client, prefix="y"
+                )
                 for j, key in enumerate(klist):
-                    key = f"y-{key.split('-')[1]}"
                     t0 = _time.perf_counter()
                     if j % 2 == 0:
                         await client.execute_write_transaction(
@@ -170,20 +175,23 @@ def run_cluster_ycsb(
             wall = _time.perf_counter() - t0
             await seed_client.close()
 
-            def pct(v, q):
-                s = sorted(v)
-                return round(s[min(len(s) - 1, int(q * len(s)))] * 1e3, 2)
-
             ops = n_clients * n_ops_per_client
             return {
                 "txn_s": round(ops / wall, 1),
-                "read_p50_ms": pct(read_lat, 0.5),
-                "read_p95_ms": pct(read_lat, 0.95),
-                "update_p50_ms": pct(update_lat, 0.5),
-                "update_p95_ms": pct(update_lat, 0.95),
+                "read_p50_ms": round(_pct(read_lat, 0.5) * 1e3, 2),
+                "read_p95_ms": round(_pct(read_lat, 0.95) * 1e3, 2),
+                "update_p50_ms": round(_pct(update_lat, 0.5) * 1e3, 2),
+                "update_p95_ms": round(_pct(update_lat, 0.95) * 1e3, 2),
                 "clients": n_clients,
                 "ops": ops,
                 "zipf_keys": n_keys,
+                # provenance emitted by the harness so --publish republishes
+                # it instead of dropping hand-edits (replicas here run the
+                # inline CPU verifier — the reference-analog path)
+                "platform": (
+                    "inline CPU verifier; 5-replica virtual cluster, rf=4, "
+                    "full signing"
+                ),
             }
 
     return asyncio.run(amain())
