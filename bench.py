@@ -339,6 +339,23 @@ def main() -> None:
     # LOUD: this number is a CPU-backend fallback, not the TPU story.
     result["tpu_unreachable"] = True
     result["tpu_errors"] = errors
+    # Point at the round's last LIVE capture so the committed evidence is
+    # one hop away even when the tunnel is dead at snapshot time (clearly
+    # labeled — the headline "value" above stays the honest CPU number).
+    try:
+        round_n = os.environ.get("MOCHI_BENCH_ROUND", "02")
+        with open(
+            os.path.join(_REPO, "benchmarks", f"results_r{round_n}_tpu.json")
+        ) as fh:
+            live = json.load(fh).get("headline", {})
+        if live.get("platform") == "tpu":
+            result["last_live_tpu_capture"] = {
+                "sigs_per_sec": live.get("value"),
+                "vs_baseline": live.get("vs_baseline"),
+                "source": "benchmarks/results_r02_tpu.json (committed live capture)",
+            }
+    except Exception:
+        pass
     print(json.dumps(result))
 
 

@@ -131,7 +131,10 @@ def run_cluster_ycsb(
 
     import numpy as np
 
-    from benchmarks.config1_cluster import _pct
+    try:
+        from benchmarks.config1_cluster import _pct
+    except ImportError:  # direct-script run: benchmarks/ is sys.path[0]
+        from config1_cluster import _pct
     from mochi_tpu.client.txn import TransactionBuilder
     from mochi_tpu.testing.virtual_cluster import VirtualCluster
 
