@@ -261,3 +261,42 @@ def test_large_values_round_trip():
             await client.close()
 
     run(main())
+
+
+def test_trimmed_read_falls_back_when_chosen_replica_is_stale():
+    """Force the quorum-sized read fan-out to include a replica that
+    silently lost the key: the trimmed tally (2 of 3) must fail closed and
+    the full-union fallback must still return the committed value."""
+
+    async def main():
+        async with VirtualCluster(4, rf=4) as vc:
+            client = vc.client()
+            await client.execute_write_transaction(
+                TransactionBuilder().write("tr-key", b"val").build()
+            )
+            # wipe the key from one in-set replica (simulated silent loss)
+            stale = vc.replicas[0]
+            stale.store.data.pop("tr-key", None)
+
+            # steer the rotor so the trimmed subset includes the stale
+            # replica (rotor increments before use inside _quorum_targets)
+            from mochi_tpu.client.txn import TransactionBuilder as TB
+
+            txn = TB().read("tr-key").build()
+            for rotor in range(4):
+                client._read_rotor = rotor - 1
+                chosen = {sid for sid, _ in client._quorum_targets(txn)}
+                if stale.server_id in chosen:
+                    client._read_rotor = rotor - 1
+                    break
+            else:
+                raise AssertionError("rotor never selected the stale replica")
+
+            before = client.metrics.timers["read-transactions"].count
+            res = await client.execute_read_transaction(txn)
+            assert res.operations[0].value == b"val"
+            # the trimmed attempt and the full-union fallback each count
+            assert client.metrics.timers["read-transactions"].count - before == 2
+            await client.close()
+
+    run(main())
