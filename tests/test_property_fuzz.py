@@ -244,3 +244,51 @@ def test_vectorized_prepare_matches_per_item_reference():
     ok = ref[6]
     for k in range(6):
         assert np.array_equal(ref[k][ok], got[k][ok]), k
+
+
+def test_field_loose_limb_invariant_under_random_op_chains():
+    """Every field op must (a) keep limbs in [0, LOOSE] — the invariant the
+    per-op bound proofs in field.py's docstrings rely on — and (b) agree
+    with python-int arithmetic mod p.  Random 40-op chains over random
+    loose inputs; any bound violation would be a latent int32-overflow
+    seed in a later multiply."""
+    import numpy as np
+
+    import jax
+
+    from mochi_tpu.crypto import field as F
+
+    rng = np.random.default_rng(0x10053)
+    B = 4
+    lanes = (B,)
+
+    def rand_loose():
+        arr = rng.integers(0, F.LOOSE + 1, size=(F.NLIMBS, B)).astype(np.int32)
+        vals = [
+            sum(int(arr[i, j]) << (F.RADIX * i) for i in range(F.NLIMBS))
+            for j in range(B)
+        ]
+        return arr, vals
+
+    a, va = rand_loose()
+    b, vb = rand_loose()
+    ops = [
+        ("add", lambda x, y: F.add(x, y), lambda u, v: u + v),
+        ("sub", lambda x, y: F.sub(x, y), lambda u, v: u - v),
+        ("mul", lambda x, y: F.mul(x, y), lambda u, v: u * v),
+        ("square", lambda x, y: F.square(x), lambda u, v: u * u),
+        ("neg", lambda x, y: F.neg(x), lambda u, v: -u),
+        ("mul3", lambda x, y: F.mul_small(x, 3), lambda u, v: u * 3),
+        ("mul121666", lambda x, y: F.mul_small(x, 486), lambda u, v: u * 486),
+    ]
+    for step in range(40):
+        name, dev_op, int_op = ops[rng.integers(len(ops))]
+        out = np.asarray(dev_op(a, b))
+        assert (out >= 0).all() and (out <= F.LOOSE).all(), (
+            step, name, int(out.min()), int(out.max()),
+        )
+        got = F.limbs_to_int_batch(np.asarray(jax.jit(F.canonical)(out)))
+        want = [int_op(u, v) % F.P_INT for u, v in zip(va, vb)]
+        assert got == want, (step, name)
+        b, vb = a, va
+        a, va = out, got
