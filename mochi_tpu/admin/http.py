@@ -39,11 +39,14 @@ _PAGE = """<!doctype html>
 """
 
 
-class AdminServer:
-    """Serves replica status over HTTP; start()/close() lifecycle."""
+class HttpJsonServer:
+    """Transport loop for tiny operator HTTP surfaces: GET-only,
+    timeout-guarded reads, header drain, Content-Length responses.
+    Subclasses implement ``_route(path) -> (status, content_type, body)``.
+    (Shared by the replica admin shell below and the verifier service's
+    ``--admin-port`` — one robust loop instead of per-surface copies.)"""
 
-    def __init__(self, replica, host: str = "127.0.0.1", port: int = 0):
-        self.replica = replica
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self.host = host
         self.port = port
         self._server: Optional[asyncio.base_events.Server] = None
@@ -61,6 +64,48 @@ class AdminServer:
             self._server.close()
             await self._server.wait_closed()
             self._server = None
+
+    def _route(self, path: str):
+        raise NotImplementedError
+
+    async def _serve(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        try:
+            request_line = await asyncio.wait_for(reader.readline(), 10.0)
+            parts = request_line.decode("latin-1").split()
+            if len(parts) < 2 or parts[0] != "GET":
+                status, ctype, body = 405, "application/json", '{"error": "GET only"}'
+            else:
+                # drain headers
+                while True:
+                    line = await asyncio.wait_for(reader.readline(), 10.0)
+                    if line in (b"\r\n", b"\n", b""):
+                        break
+                status, ctype, body = self._route(parts[1].split("?")[0])
+            payload = body.encode()
+            reason = {200: "OK", 404: "Not Found", 405: "Method Not Allowed"}[status]
+            writer.write(
+                f"HTTP/1.1 {status} {reason}\r\n"
+                f"Content-Type: {ctype}\r\n"
+                f"Content-Length: {len(payload)}\r\n"
+                "Connection: close\r\n\r\n".encode() + payload
+            )
+            await writer.drain()
+        except (asyncio.TimeoutError, ConnectionResetError, UnicodeDecodeError):
+            pass
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+
+class AdminServer(HttpJsonServer):
+    """Serves replica status over HTTP; start()/close() lifecycle."""
+
+    def __init__(self, replica, host: str = "127.0.0.1", port: int = 0):
+        super().__init__(host, port)
+        self.replica = replica
 
     # ------------------------------------------------------------ handlers
 
@@ -97,34 +142,3 @@ class AdminServer:
         if path == "/" or path == "/index.html":
             return 200, "text/html", _PAGE.format(server_id=r.server_id)
         return 404, "application/json", json.dumps({"error": "not found"})
-
-    async def _serve(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
-        try:
-            request_line = await asyncio.wait_for(reader.readline(), 10.0)
-            parts = request_line.decode("latin-1").split()
-            if len(parts) < 2 or parts[0] != "GET":
-                status, ctype, body = 405, "application/json", '{"error": "GET only"}'
-            else:
-                # drain headers
-                while True:
-                    line = await asyncio.wait_for(reader.readline(), 10.0)
-                    if line in (b"\r\n", b"\n", b""):
-                        break
-                status, ctype, body = self._route(parts[1].split("?")[0])
-            payload = body.encode()
-            reason = {200: "OK", 404: "Not Found", 405: "Method Not Allowed"}[status]
-            writer.write(
-                f"HTTP/1.1 {status} {reason}\r\n"
-                f"Content-Type: {ctype}\r\n"
-                f"Content-Length: {len(payload)}\r\n"
-                "Connection: close\r\n\r\n".encode() + payload
-            )
-            await writer.drain()
-        except (asyncio.TimeoutError, ConnectionResetError, UnicodeDecodeError):
-            pass
-        finally:
-            writer.close()
-            try:
-                await writer.wait_closed()
-            except Exception:
-                pass

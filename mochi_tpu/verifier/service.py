@@ -44,6 +44,7 @@ import time
 from pathlib import Path
 from typing import List, Optional, Sequence
 
+from ..admin.http import HttpJsonServer
 from ..cluster.config import ServerInfo
 from ..crypto import session as session_crypto
 from ..net.transport import RpcServer, _Connection, new_msg_id
@@ -124,6 +125,26 @@ class VerifierService:
     @property
     def bound_port(self) -> int:
         return self.rpc.bound_port
+
+    def status(self) -> dict:
+        """Operational counters for the one process that owns the device
+        (served over HTTP via ``--admin-port``; the replica-side analog is
+        the admin shell's ``/metrics``)."""
+        st: dict = {
+            "service_id": SERVICE_ID,
+            "requests": self.requests,
+            "items": self.items,
+            "authenticated": self.secret is not None,
+        }
+        v = self.verifier
+        if isinstance(v, CachingVerifier):
+            st["cache_hits"] = v.hits
+            st["cache_misses"] = v.misses
+            v = v.inner
+        for attr in ("batches_flushed", "items_verified", "fallback_batches"):
+            if hasattr(v, attr):
+                st[attr] = getattr(v, attr)
+        return st
 
     async def _handle(self, env: Envelope) -> Optional[Envelope]:
         def fail(ft: FailType, detail: str) -> Envelope:
@@ -260,11 +281,34 @@ async def amain(args) -> None:
         host=args.host, port=args.port, verifier=verifier, secret=secret
     )
     await service.start()
+    admin = None
+    if args.admin_port is not None:
+        admin = ServiceAdminServer(service, port=args.admin_port)
+        await admin.start()
     print(f"READY {SERVICE_ID} {service.bound_port}", flush=True)
     try:
         await asyncio.Event().wait()
     finally:
+        if admin is not None:
+            await admin.close()
         await service.close()
+
+
+class ServiceAdminServer(HttpJsonServer):
+    """Loopback HTTP status endpoint for the standalone service: /status
+    (and /) serve :meth:`VerifierService.status` as JSON.  Reuses the
+    admin shell's hardened transport loop (read timeouts, header drain)."""
+
+    def __init__(self, service: VerifierService, host: str = "127.0.0.1", port: int = 0):
+        super().__init__(host, port)
+        self.service = service
+
+    def _route(self, path: str):
+        import json as _json
+
+        if path in ("/", "/status", "/metrics"):
+            return 200, "application/json", _json.dumps(self.service.status())
+        return 404, "application/json", '{"error": "not found"}'
 
 
 def main(argv=None) -> None:
@@ -282,6 +326,12 @@ def main(argv=None) -> None:
         default=None,
         help="hex shared secret: MAC-authenticate the verify RPC in both "
         "directions (required when the service is not loopback-only)",
+    )
+    parser.add_argument(
+        "--admin-port",
+        type=int,
+        default=None,
+        help="serve service counters as JSON over loopback HTTP (0 = ephemeral)",
     )
     parser.add_argument("--log-level", default="INFO")
     args = parser.parse_args(argv)

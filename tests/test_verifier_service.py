@@ -179,3 +179,44 @@ def test_cluster_survives_service_death_and_recovery():
                 await service2.close()
 
     run(main())
+
+
+def test_service_status_counters_and_admin_endpoint():
+    """status() reports request/item/cache counters, and the standalone
+    CLI's --admin-port serves them as JSON over loopback HTTP."""
+    import json
+    import urllib.request
+
+    from mochi_tpu.crypto import keys
+    from mochi_tpu.verifier.service import ServiceAdminServer, VerifierService
+    from mochi_tpu.verifier.spi import VerifyItem
+
+    async def main():
+        svc = VerifierService(port=0, verifier=CpuVerifier())
+        await svc.start()
+        admin = ServiceAdminServer(svc, port=0)
+        await admin.start()
+        try:
+            rv = RemoteVerifier("127.0.0.1", svc.bound_port)
+            kp = keys.generate_keypair()
+            items = [VerifyItem(kp.public_key, b"s", kp.sign(b"s"))] * 6
+            assert await rv.verify_batch(items) == [True] * 6
+            await rv.close()
+
+            st = svc.status()
+            assert st["requests"] == 1 and st["items"] == 6
+            assert st["cache_hits"] == 5 and st["cache_misses"] == 1
+            assert st["authenticated"] is False
+
+            port = admin.bound_port
+            raw = await asyncio.to_thread(
+                lambda: urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/status", timeout=5
+                ).read()
+            )
+            assert json.loads(raw) == st
+        finally:
+            await admin.close()
+            await svc.close()
+
+    run(main())
