@@ -38,6 +38,7 @@ if [ "$VERIFIER" = "remote" ]; then
   python -m mochi_tpu.verifier.service --port "$VPORT" \
     --backend "${MOCHI_VERIFIER_BACKEND:-tpu}" \
     --secret-file "$OUT/verifier.secret" \
+    --admin-port $((VPORT + 1)) \
     >"$OUT/log/verifier.log" 2>&1 &
   PIDS+=($!)
   for _ in $(seq 1 120); do
