@@ -20,6 +20,8 @@ import asyncio
 import json
 from typing import Optional
 
+from ..verifier.spi import verifier_stats
+
 _PAGE = """<!doctype html>
 <html><head><title>mochi-tpu replica</title>
 <style>
@@ -130,7 +132,7 @@ class AdminServer(HttpJsonServer):
                         "servers": {s.server_id: s.url for s in cfg.servers.values()},
                     },
                     "store": r.store.stats(),
-                    "verifier": type(r.verifier).__name__ if r.verifier else "CpuVerifier",
+                    "verifier": verifier_stats(r.verifier),
                     "sessions": len(getattr(r, "_sessions", {})),
                     "config_history_stamps": sorted(r.store.config_history),
                     "member": r.server_id in cfg.servers,

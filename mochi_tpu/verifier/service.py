@@ -61,6 +61,7 @@ from .spi import (
     CpuVerifier,
     SignatureVerifier,
     VerifyItem,
+    verifier_stats,
 )
 
 LOG = logging.getLogger(__name__)
@@ -130,21 +131,13 @@ class VerifierService:
         """Operational counters for the one process that owns the device
         (served over HTTP via ``--admin-port``; the replica-side analog is
         the admin shell's ``/metrics``)."""
-        st: dict = {
+        return {
             "service_id": SERVICE_ID,
             "requests": self.requests,
             "items": self.items,
             "authenticated": self.secret is not None,
+            "verifier": verifier_stats(self.verifier),
         }
-        v = self.verifier
-        if isinstance(v, CachingVerifier):
-            st["cache_hits"] = v.hits
-            st["cache_misses"] = v.misses
-            v = v.inner
-        for attr in ("batches_flushed", "items_verified", "fallback_batches"):
-            if hasattr(v, attr):
-                st[attr] = getattr(v, attr)
-        return st
 
     async def _handle(self, env: Envelope) -> Optional[Envelope]:
         def fail(ft: FailType, detail: str) -> Envelope:

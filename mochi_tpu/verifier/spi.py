@@ -274,3 +274,27 @@ class BatchingVerifier(SignatureVerifier):
             if not fut.done():
                 fut.cancel()
         self._pending.clear()
+
+
+def verifier_stats(verifier) -> dict:
+    """Type + counters for any verifier composition, recursively unwrapping
+    ``.inner`` (CachingVerifier, BatchingVerifier-over-Remote, ...).  The
+    single extractor behind BOTH operator surfaces — the replica admin
+    /status and the verifier service's --admin-port — so key names cannot
+    drift between them."""
+    st: dict = {"type": type(verifier).__name__ if verifier else "CpuVerifier"}
+    for attr in (
+        "batches_flushed",
+        "items_verified",
+        "remote_batches",
+        "fallback_batches",
+        "hits",
+        "misses",
+    ):
+        v = getattr(verifier, attr, None)
+        if isinstance(v, int):
+            st[attr] = v
+    inner = getattr(verifier, "inner", None)
+    if inner is not None:
+        st["inner"] = verifier_stats(inner)
+    return st

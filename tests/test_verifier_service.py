@@ -205,7 +205,10 @@ def test_service_status_counters_and_admin_endpoint():
 
             st = svc.status()
             assert st["requests"] == 1 and st["items"] == 6
-            assert st["cache_hits"] == 5 and st["cache_misses"] == 1
+            vs = st["verifier"]
+            assert vs["type"] == "CachingVerifier"
+            assert vs["hits"] == 5 and vs["misses"] == 1
+            assert vs["inner"]["type"] == "CpuVerifier"
             assert st["authenticated"] is False
 
             port = admin.bound_port
