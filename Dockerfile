@@ -22,10 +22,18 @@ ENV PYTHONPATH=/app
 # protocol port + admin port
 EXPOSE 8101 9101
 
+# liveness via the admin shell (loopback inside the container: the admin
+# endpoints stay on 127.0.0.1 unless ADMIN_HOST widens them deliberately)
+HEALTHCHECK --interval=15s --timeout=4s --retries=3 CMD \
+  python -c "import os,urllib.request;urllib.request.urlopen('http://127.0.0.1:%s/status' % os.environ.get('ADMIN_PORT','9101'),timeout=3)" || exit 1
+
+# ADMIN_HOST stays loopback by default (the in-container healthcheck is the
+# consumer); set ADMIN_HOST=0.0.0.0 to publish it through -p 9101:9101.
 CMD python -m mochi_tpu.server \
       --config "${CLUSTER_CONFIG}" \
       --server-id "${CLUSTER_CURRENT_SERVER}" \
       --seed-file "${SEED_FILE}" \
       --host 0.0.0.0 \
+      --admin-host "${ADMIN_HOST:-127.0.0.1}" \
       --admin-port "${ADMIN_PORT:-9101}" \
       --verifier "${MOCHI_VERIFIER:-cpu}"
