@@ -200,3 +200,47 @@ def test_caching_verifier_waiter_survives_dispatcher_failure():
         assert await t2 == [True]
 
     asyncio.run(main())
+
+
+def test_batcher_runs_chunks_concurrently_up_to_max_inflight():
+    """The max_inflight semaphore must deliver real overlap: a backlog of
+    4 chunks against a slow backend with max_inflight=2 must reach 2
+    concurrent backend calls — and never exceed the cap."""
+    import asyncio
+    import threading
+    import time
+
+    from mochi_tpu.crypto import keys
+    from mochi_tpu.verifier.spi import BatchingVerifier, VerifyItem
+
+    kp = keys.generate_keypair()
+    lock = threading.Lock()
+    state = {"now": 0, "peak": 0}
+
+    def slow_backend(chunk):
+        with lock:
+            state["now"] += 1
+            state["peak"] = max(state["peak"], state["now"])
+        time.sleep(0.15)
+        with lock:
+            state["now"] -= 1
+        return [True] * len(chunk)
+
+    async def main():
+        bv = BatchingVerifier(
+            slow_backend, max_batch=4, max_delay_s=0.0, max_inflight=2
+        )
+        items = [
+            VerifyItem(kp.public_key, b"p%d" % i, kp.sign(b"p%d" % i))
+            for i in range(16)
+        ]
+        tasks = [
+            asyncio.create_task(bv.verify_batch(items[i * 4 : (i + 1) * 4]))
+            for i in range(4)
+        ]
+        results = await asyncio.gather(*tasks)
+        assert all(all(r) for r in results)
+        await bv.close()
+        assert state["peak"] == 2, f"peak concurrency {state['peak']}, want 2"
+
+    asyncio.run(main())
