@@ -271,10 +271,6 @@ def verify_batch(
     return _readback((_launch(items, device, bucket)), len(items))
 
 
-# Bounded launch-ahead for the chunked path (see verify_batch).
-_PIPELINE_DEPTH = 4
-
-
 def _readback(launched, n: int) -> List[bool]:
     """Block on one launched chunk and combine with its host prechecks."""
     bitmap_dev, pre_ok = launched

@@ -108,6 +108,15 @@ class CachingVerifier(SignatureVerifier):
             self._inflight.update(futs)
             try:
                 bitmap = await self.inner.verify_batch(reps)
+                if len(bitmap) != len(reps):
+                    # A short/long bitmap would silently truncate the zip
+                    # below, leaving the tail keys' futures unresolved forever
+                    # (concurrent waiters would hang).  Route through the same
+                    # cleanup path as a dispatch failure.
+                    raise RuntimeError(
+                        f"inner verifier returned {len(bitmap)} verdicts "
+                        f"for {len(reps)} items"
+                    )
             except BaseException:
                 # Dispatch failed (or owner cancelled): resolve the futures
                 # with a retry sentinel rather than an exception — a

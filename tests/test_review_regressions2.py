@@ -244,3 +244,46 @@ def test_batcher_runs_chunks_concurrently_up_to_max_inflight():
         assert state["peak"] == 2, f"peak concurrency {state['peak']}, want 2"
 
     asyncio.run(main())
+
+
+def test_caching_verifier_rejects_short_bitmap():
+    """A misbehaving inner verifier that returns fewer verdicts than items
+    must fail loudly AND resolve the in-flight futures with the retry
+    sentinel — a truncated zip would otherwise leave concurrent waiters on
+    the tail keys hanging forever (round-3 advice)."""
+    import asyncio
+
+    from mochi_tpu.crypto import keys
+    from mochi_tpu.verifier.spi import CachingVerifier, SignatureVerifier, VerifyItem
+
+    class ShortVerifier(SignatureVerifier):
+        def __init__(self):
+            self.calls = 0
+
+        async def verify_batch(self, items):
+            self.calls += 1
+            await asyncio.sleep(0.05)
+            if self.calls == 1:
+                return [True] * (len(items) - 1)  # short!
+            return [
+                keys.verify(it.public_key, it.message, it.signature)
+                for it in items
+            ]
+
+    async def main():
+        cv = CachingVerifier(ShortVerifier())
+        kp = keys.generate_keypair()
+        items = [
+            VerifyItem(kp.public_key, m, kp.sign(m)) for m in (b"a", b"b")
+        ]
+        t1 = asyncio.create_task(cv.verify_batch(items))
+        await asyncio.sleep(0.01)
+        # waiter joins the in-flight futures for the same keys
+        t2 = asyncio.create_task(cv.verify_batch(items))
+        (r1,) = await asyncio.gather(t1, return_exceptions=True)
+        assert isinstance(r1, RuntimeError)
+        # the waiter must complete (not hang) with correct verdicts
+        assert await asyncio.wait_for(t2, timeout=5) == [True, True]
+        assert not cv._inflight
+
+    asyncio.run(main())
