@@ -405,9 +405,55 @@ static PyObject *mcode_decode(PyObject *self, PyObject *arg) {
     return out;
 }
 
+/* Envelope fast path: decode a wire envelope (top-level 8-element list) and
+ * additionally report the stream offset just past element 6.  The signing
+ * bytes are "mochi.env\0" + T_LIST + varint(6) + wire[2:off6] — the signed
+ * prefix is a contiguous slice of the wire encoding — so the receiver can
+ * authenticate without re-encoding the payload tree (the dominant cost of
+ * the Python signing_bytes() path, see protocol/messages.py).  Returns
+ * (list_of_8_values, off6). */
+static PyObject *mcode_decode_env(PyObject *self, PyObject *arg) {
+    Py_buffer view;
+    if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) < 0) return NULL;
+    Rd r = {(const unsigned char *)view.buf, view.len, 0};
+    PyObject *list = NULL;
+    Py_ssize_t off6 = 0;
+    if (r.len < 2 || r.data[0] != T_LIST) {
+        PyErr_SetString(PyExc_ValueError, "mcode: envelope must be a list");
+        goto fail;
+    }
+    r.pos = 1;
+    unsigned long long n;
+    if (rd_varint(&r, &n) < 0) goto fail;
+    if (n != 8) {
+        PyErr_Format(PyExc_ValueError, "mcode: envelope needs 8 elements, got %llu", n);
+        goto fail;
+    }
+    list = PyList_New(8);
+    if (!list) goto fail;
+    for (Py_ssize_t i = 0; i < 8; i++) {
+        PyObject *item = rd_value(&r, 1);
+        if (!item) goto fail;
+        PyList_SET_ITEM(list, i, item);
+        if (i == 5) off6 = r.pos;
+    }
+    if (r.pos != r.len) {
+        PyErr_SetString(PyExc_ValueError, "mcode: trailing bytes after value");
+        goto fail;
+    }
+    PyBuffer_Release(&view);
+    return Py_BuildValue("(Nn)", list, off6);
+fail:
+    Py_XDECREF(list);
+    PyBuffer_Release(&view);
+    return NULL;
+}
+
 static PyMethodDef mcode_methods[] = {
     {"encode", mcode_encode, METH_O, "Canonically encode a structural value to bytes."},
     {"decode", mcode_decode, METH_O, "Decode mcode bytes; rejects trailing garbage."},
+    {"decode_env", mcode_decode_env, METH_O,
+     "Decode an 8-element envelope list; returns (values, offset_past_elem6)."},
     {NULL, NULL, 0, NULL},
 };
 

@@ -180,6 +180,29 @@ def _decode_py(data: bytes) -> Any:
     return value
 
 
+def _decode_env_py(data: bytes) -> "tuple[list, int]":
+    """Decode a wire envelope (top-level 8-element list) and report the
+    stream offset just past element 6.  The signed prefix of an envelope is
+    a contiguous slice of its wire encoding (see ``messages.Envelope``), so
+    receivers authenticate by slicing instead of re-encoding the payload."""
+    reader = _Reader(bytes(data))
+    if not reader.data or reader.data[0] != T_LIST:
+        raise ValueError("mcode: envelope must be a list")
+    reader.pos = 1
+    n = reader.read_varint()
+    if n != 8:
+        raise ValueError(f"mcode: envelope needs 8 elements, got {n}")
+    values = []
+    off6 = 0
+    for i in range(8):
+        values.append(reader.read_value(1))
+        if i == 5:
+            off6 = reader.pos
+    if reader.pos != len(reader.data):
+        raise ValueError("mcode: trailing bytes after value")
+    return values, off6
+
+
 # Prefer the native codec (mochi_tpu/native/mcode.c — bit-identical, ~20x
 # faster; tests/test_codec.py checks the two differentially).  The pure-Python
 # path stays both as fallback and as the readable spec of the format.
@@ -189,10 +212,12 @@ def _bind():
 
         mod = get_mcode()
         if mod is not None:
-            return mod.encode, mod.decode
+            # decode_env: getattr-guard so a stale prebuilt .so (older than
+            # this source) still binds its encode/decode.
+            return mod.encode, mod.decode, getattr(mod, "decode_env", _decode_env_py)
     except Exception:  # pragma: no cover - import-time safety net
         pass
-    return _encode_py, _decode_py
+    return _encode_py, _decode_py, _decode_env_py
 
 
-encode, decode = _bind()
+encode, decode, decode_env = _bind()

@@ -23,7 +23,7 @@ from enum import IntEnum
 from functools import cached_property
 from typing import Any, Dict, Optional, Tuple, Type
 
-from .codec import decode, encode
+from .codec import decode, decode_env, encode
 
 
 class Action(IntEnum):
@@ -606,19 +606,34 @@ class Envelope:
         # to __dict__, bypassing the frozen __setattr__.
         return self.payload.to_obj()
 
-    def signing_bytes(self) -> bytes:
-        """Canonical bytes covered by BOTH auth mechanisms (signature or
-        session MAC) — everything except the auth fields themselves."""
+    @cached_property
+    def _six_bytes(self) -> bytes:
+        """mcode encoding of the 6 authenticated fields (a 6-element list).
+
+        This is the one payload-tree walk per envelope: the wire encoding is
+        assembled from it by concatenation (``encode_envelope``), and
+        receivers recover it as a *slice* of the incoming frame
+        (``decode_envelope``), so neither side ever encodes the tree twice.
+        The 2-byte header is always T_LIST + varint(6) = b"\\x07\\x06".
+        """
         tag = _TAG_BY_TYPE[type(self.payload)]
-        return b"mochi.env\x00" + encode(
+        return encode(
             [tag, self._payload_obj, self.msg_id, self.sender_id, self.reply_to, self.timestamp_ms]
         )
 
+    def signing_bytes(self) -> bytes:
+        """Canonical bytes covered by BOTH auth mechanisms (signature or
+        session MAC) — everything except the auth fields themselves."""
+        return b"mochi.env\x00" + self._six_bytes
+
     def _with_cache(self, **changes) -> "Envelope":
-        env = replace(self, **changes)
-        cached = self.__dict__.get("_payload_obj")
-        if cached is not None:
-            env.__dict__["_payload_obj"] = cached
+        # Copy-with-changes without dataclasses.replace(): replace() re-runs
+        # the frozen __init__ (object.__setattr__ per field) and this runs
+        # once or twice per message on the cluster hot path.  A __dict__
+        # copy also carries the cached _payload_obj along for free.
+        env = object.__new__(Envelope)
+        env.__dict__.update(self.__dict__)
+        env.__dict__.update(changes)
         return env
 
     def with_signature(self, sig: bytes) -> "Envelope":
@@ -628,25 +643,42 @@ class Envelope:
         return self._with_cache(mac=tag)
 
 
+def _enc_auth(v: Optional[bytes]) -> bytes:
+    """Encode one auth field (None or short bytes) — the trailing two wire
+    elements.  Signatures are 64 bytes and MACs 32, so the varint length is
+    a single byte; the general encoder handles anything longer."""
+    if v is None:
+        return b"\x00"  # T_NONE
+    if len(v) < 0x80:
+        return b"\x05" + bytes((len(v),)) + v  # T_BYTES + 1-byte varint
+    return encode(v)
+
+
 def encode_envelope(env: Envelope) -> bytes:
-    tag = _TAG_BY_TYPE[type(env.payload)]
-    return encode(
-        [
-            tag,
-            env._payload_obj,
-            env.msg_id,
-            env.sender_id,
-            env.reply_to,
-            env.timestamp_ms,
-            env.signature,
-            env.mac,
-        ]
-    )
+    # Wire = T_LIST(8) + the cached 6 authenticated elements + sig + mac.
+    # The seal/sign step already computed _six_bytes (signing_bytes), and
+    # with_mac/with_signature carry the cache, so this is pure concatenation.
+    return b"\x07\x08" + env._six_bytes[2:] + _enc_auth(env.signature) + _enc_auth(env.mac)
 
 
 def decode_envelope(data: bytes) -> Envelope:
-    tag, payload_obj, msg_id, sender_id, reply_to, ts, sig, mac = decode(data)
+    (tag, payload_obj, msg_id, sender_id, reply_to, ts, sig, mac), off6 = decode_env(data)
     if not 0 <= tag < len(_PAYLOAD_TYPES):
         raise ValueError(f"unknown payload tag {tag}")
     payload = _PAYLOAD_TYPES[tag].from_obj(payload_obj)
-    return Envelope(payload, msg_id, sender_id, reply_to, ts, sig, mac)
+    env = object.__new__(Envelope)  # skip the frozen-dataclass __init__
+    env.__dict__.update(
+        payload=payload,
+        msg_id=msg_id,
+        sender_id=sender_id,
+        reply_to=reply_to,
+        timestamp_ms=ts,
+        signature=sig,
+        mac=mac,
+        # The signed prefix is a contiguous slice of the frame: recovering
+        # it here means authenticating this envelope (signing_bytes) never
+        # re-encodes the payload tree it just decoded.
+        _payload_obj=payload_obj,
+        _six_bytes=b"\x07\x06" + bytes(data[2:off6]),
+    )
+    return env

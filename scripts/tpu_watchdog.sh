@@ -1,0 +1,39 @@
+#!/usr/bin/env bash
+# Tunnel watchdog: probe the axon TPU every ~3 min; the moment a probe
+# passes, run the full measurement battery (scripts/tpu_measure.sh) once
+# and exit.  Round-2 lesson: the relay wedges for hours at a time and
+# chip time is scarce — capture everything the first moment it's alive.
+#
+# Probe = real device work with np.asarray readback (block_until_ready
+# through the relay is untrustworthy), in a watchdogged subprocess so a
+# wedged backend-init can't hang the loop.
+set -uo pipefail
+REPO_DIR=$(cd "$(dirname "$0")/.." && pwd)
+cd "$REPO_DIR"
+ROUND=${1:-03}
+LOG="benchmarks/tpu_watchdog_r${ROUND}.log"
+
+probe() {
+  timeout 150 python -u - <<'EOF' >/dev/null 2>&1
+import jax, numpy as np, jax.numpy as jnp
+jax.config.update("jax_compilation_cache_dir", ".jax_cache")
+d = jax.devices()[0]
+assert d.platform == "tpu"
+y = jnp.ones((256, 256), jnp.bfloat16) @ jnp.ones((256, 256), jnp.bfloat16)
+assert float(np.asarray(y)[0, 0]) == 256.0
+EOF
+}
+
+echo "[watchdog] start $(date -u +%FT%TZ)" | tee -a "$LOG"
+n=0
+while true; do
+  n=$((n + 1))
+  if probe; then
+    echo "[watchdog] probe $n LIVE $(date -u +%FT%TZ) — firing battery" | tee -a "$LOG"
+    bash scripts/tpu_measure.sh "$ROUND" 2>&1 | tail -40 >>"$LOG"
+    echo "[watchdog] battery done $(date -u +%FT%TZ) rc=$?" | tee -a "$LOG"
+    exit 0
+  fi
+  echo "[watchdog] probe $n dead $(date -u +%FT%TZ)" >>"$LOG"
+  sleep 170
+done
