@@ -151,6 +151,9 @@ def run(
     sweeps: int = 2,
     verifier: str = "service",
 ) -> Dict:
+    from mochi_tpu.utils.runtime import tune_gc_for_server
+
+    tune_gc_for_server()  # same GC posture the real server processes run with
     return asyncio.run(_run(n_clients, keys_per_client, sweeps, verifier))
 
 

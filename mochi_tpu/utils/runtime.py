@@ -1,0 +1,32 @@
+"""Process-level runtime tuning for mochi server/verifier processes.
+
+The asyncio request path allocates heavily (envelopes, futures, frames);
+CPython's default generational GC thresholds (700, 10, 10) make gen-0/1
+collections fire every few requests, and the collector walks the whole
+young set each time.  Relaxing the thresholds and freezing the post-boot
+heap into the permanent generation was measured at +15-20% cluster
+throughput on the config-1 bench (5 replicas, 40 clients, single core);
+20k/50k/200k gen-0 thresholds all measured within noise of each other,
+so the value below is not delicate.
+
+This is deliberately a *server-process* knob, called from process entry
+points (``server/__main__.py``, ``verifier.service:main``, the benchmark
+harnesses) — never on library import, which would impose our GC policy on
+embedding applications.
+"""
+
+from __future__ import annotations
+
+import gc
+
+
+def tune_gc_for_server() -> None:
+    """Relax GC for allocation-heavy serving; freeze the boot-time heap.
+
+    Reference-cycle garbage still gets collected — only less often, with
+    the (acyclic) steady-state request garbage reclaimed by refcounting as
+    usual.  Call after imports/boot so ``gc.freeze`` captures module state.
+    """
+    gc.collect()
+    gc.freeze()
+    gc.set_threshold(50000, 50, 50)

@@ -331,6 +331,9 @@ def main(argv=None) -> None:
     logging.basicConfig(
         level=args.log_level, format="%(asctime)s %(name)s %(levelname)s %(message)s"
     )
+    from ..utils.runtime import tune_gc_for_server
+
+    tune_gc_for_server()
     try:
         asyncio.run(amain(args))
     except KeyboardInterrupt:
