@@ -13,19 +13,21 @@ collective per step; the heavy math never leaves the chip.
 All shapes are static; callers pad the batch to a multiple of the mesh size
 (:func:`pad_to_multiple`) with lanes whose ``group_id`` points at a dead slot.
 
-Multi-host (DCN) scaling — designed, pending multi-host hardware: the same
-program runs unchanged under ``jax.distributed.initialize()`` on a
-multi-host slice — ``jax.devices()`` then spans hosts, :func:`make_mesh`
-builds the global mesh, and each host feeds its addressable shard of the
-batch (``jax.make_array_from_process_local_data``).  Because verification
-is embarrassingly parallel with the single ``psum`` tally as the only
-collective, the DCN hop costs one small all-reduce per step; batches
-should shard so each host's lanes come from its own colocated verifier
-service (the service already owns batching, so each host-local service
-simply becomes one feeder of the global mesh).  This mirrors the
-reference's topology, where the only cross-host traffic is the
-client↔replica fan-out (SURVEY.md §2.9 — it has no server↔server links at
-all); the data-plane collective is new capability.
+Multi-host (DCN) scaling is implemented in ``parallel/multihost.py``: the
+same program runs unchanged under ``jax.distributed.initialize()`` —
+``jax.devices()`` then spans hosts, :func:`make_mesh` builds the global
+mesh, and each host feeds its addressable shard of the batch
+(``multihost.host_local_to_global``).  Because verification is
+embarrassingly parallel with the single ``psum`` tally as the only
+collective, the DCN hop costs one small all-reduce per step; each host's
+lanes come from its own colocated verifier service (the service already
+owns batching, so each host-local service simply becomes one feeder of
+the global mesh).  Proven end-to-end by the 2-process CPU-mesh test
+(``tests/test_parallel_multiproc.py``); cross-host cluster layout in
+``config/multihost5/``.  This mirrors the reference's topology, where the
+only cross-host traffic is the client↔replica fan-out (SURVEY.md §2.9 —
+it has no server↔server links at all); the data-plane collective is new
+capability.
 """
 
 from __future__ import annotations

@@ -28,7 +28,14 @@ def main(argv=None) -> None:
     parser.add_argument("--servers", type=int, default=5)
     parser.add_argument("--rf", type=int, default=4)
     parser.add_argument("--base-port", type=int, default=8001)
-    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument(
+        "--host",
+        default="127.0.0.1",
+        help="bind host for every server, OR a comma-separated host list "
+        "assigned round-robin for a cross-host cluster (the reference's "
+        "5-EC2-host shape, /root/reference/config/aws_5_config) — e.g. "
+        "--host host-a,host-b,host-c,host-d,host-e",
+    )
     parser.add_argument("--format", choices=("json", "properties"), default="json")
     parser.add_argument(
         "--with-admin",
@@ -45,8 +52,16 @@ def main(argv=None) -> None:
 
     server_ids = [f"server-{i}" for i in range(args.servers)]
     keypairs = {sid: generate_keypair() for sid in server_ids}
+    hosts = args.host.split(",")
     config = ClusterConfig.build(
-        {sid: f"{args.host}:{args.base_port + i}" for i, sid in enumerate(server_ids)},
+        {
+            # round-robin across hosts; ports advance only when a host wraps,
+            # so every host runs the same well-known port where possible
+            sid: f"{hosts[i % len(hosts)]}:{args.base_port + i // len(hosts)}"
+            if len(hosts) > 1
+            else f"{hosts[0]}:{args.base_port + i}"
+            for i, sid in enumerate(server_ids)
+        },
         rf=args.rf,
         public_keys={sid: kp.public_key for sid, kp in keypairs.items()},
     )

@@ -1,0 +1,92 @@
+"""Multi-host DCN path proof: 2 OS processes, one global 8-device mesh.
+
+Runs ``mochi_tpu.parallel.multihost._demo_main`` in two subprocesses —
+process 0 hosts the ``jax.distributed`` coordinator — each with 4 virtual
+CPU devices (``--xla_force_host_platform_device_count``), and asserts:
+
+* both processes join one runtime (process_count == 2, 8 global devices);
+* the sharded verify + quorum ``psum`` runs across the process boundary;
+* both processes compute identical, closed-form-correct group tallies.
+
+This is the documented single-machine recipe for exercising the real
+multi-host code path (the same calls a TPU pod slice runs under); the
+reference has no distributed runtime to compare against (SURVEY.md §2.9).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_mesh_quorum_step():
+    port = _free_port()
+    lanes = 8  # per process; lanes i%4==3 corrupted, group = i%3
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
+    )
+    # append (not prepend): with repeated flags XLA honors the LAST one, and
+    # the test harness environment may already force a device count
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=4"
+    ).strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    procs = [
+        subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "mochi_tpu.parallel.multihost",
+                "--coordinator",
+                f"127.0.0.1:{port}",
+                "--num-processes",
+                "2",
+                "--process-id",
+                str(pid),
+                "--lanes-per-process",
+                str(lanes),
+            ],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=240)
+        assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
+        outs.append(json.loads(out.strip().splitlines()[-1]))
+
+    # Closed-form expectation: per process, lanes 0..7 -> groups
+    # [0,1,2,0,1,2,0,1], corrupted lanes {3,7} -> groups {0,1}.  Valid per
+    # process: g0 gets lanes {0,6}=2, g1 gets {1,4}... compute directly:
+    valid_per_group = [0, 0, 0]
+    for i in range(lanes):
+        if i % 4 != 3:
+            valid_per_group[i % 3] += 1
+    expected = [2 * v for v in valid_per_group]  # two identical processes
+
+    for rec in outs:
+        assert rec["process_count"] == 2
+        assert rec["global_devices"] == 8
+        assert rec["local_devices"] == 4
+        assert rec["counts"] == expected, rec
+        assert rec["committed"] == [c >= 3 for c in expected]
+        assert rec["local_valid"] == sum(valid_per_group)
+    # identical replicated tallies on both hosts
+    assert outs[0]["counts"] == outs[1]["counts"]
