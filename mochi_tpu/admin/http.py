@@ -23,22 +23,51 @@ from typing import Optional
 from ..verifier.spi import verifier_stats
 
 _PAGE = """<!doctype html>
-<html><head><title>mochi-tpu replica</title>
+<html><head><title>mochi-tpu replica {server_id}</title>
+<meta http-equiv="refresh" content="3">
 <style>
- body {{ font-family: system-ui, sans-serif; margin: 2rem auto; max-width: 40rem; }}
+ body {{ font-family: system-ui, sans-serif; margin: 2rem auto; max-width: 46rem;
+         color: #1a1a2e; }}
  code {{ background: #f0f0f0; padding: 0.1rem 0.3rem; border-radius: 4px; }}
- li {{ margin: 0.4rem 0; }}
+ table {{ border-collapse: collapse; margin: 0.6rem 0 1.2rem; }}
+ th, td {{ text-align: left; padding: 0.25rem 0.9rem 0.25rem 0; }}
+ th {{ border-bottom: 1px solid #ccc; font-weight: 600; }}
+ .me {{ font-weight: 700; }}
+ .muted {{ color: #667; }}
+ li {{ margin: 0.3rem 0; }}
 </style></head>
 <body>
-<h1>mochi-tpu replica: {server_id}</h1>
-<p>BFT transactional KV store, TPU-batched signature verification.</p>
+<h1>mochi-tpu replica <code>{server_id}</code></h1>
+<p class="muted">BFT transactional KV store, TPU-batched signature
+verification &middot; configstamp {configstamp} &middot; rf={rf} f={f}
+quorum={quorum} &middot; {member}</p>
+<h2>Membership</h2>
+<table><tr><th>server</th><th>endpoint</th></tr>{member_rows}</table>
+<h2>Store</h2>
+<table>{store_rows}</table>
+<h2>Verifier</h2>
+<table>{verifier_rows}</table>
+<p class="muted">{sessions} live client sessions &middot;
+admin-gated: {admin_gated} &middot; page auto-refreshes</p>
 <ul>
-<li><a href="/status"><code>/status</code></a> — replica + cluster state</li>
+<li><a href="/status"><code>/status</code></a> — this view as JSON</li>
 <li><a href="/metrics"><code>/metrics</code></a> — timers and counters</li>
 <li><a href="/json"><code>/json</code></a> — hello record</li>
 </ul>
 </body></html>
 """
+
+
+def _esc(s) -> str:
+    return (
+        str(s).replace("&", "&amp;").replace("<", "&lt;").replace(">", "&gt;")
+    )
+
+
+def _rows(d: dict) -> str:
+    return "".join(
+        f"<tr><td>{_esc(k)}</td><td>{_esc(v)}</td></tr>" for k, v in d.items()
+    )
 
 
 class HttpJsonServer:
@@ -142,5 +171,23 @@ class AdminServer(HttpJsonServer):
         if path == "/metrics":
             return 200, "application/json", json.dumps(r.metrics.snapshot())
         if path == "/" or path == "/index.html":
-            return 200, "text/html", _PAGE.format(server_id=r.server_id)
+            cfg = r.config
+            member_rows = "".join(
+                f'<tr class="{"me" if s.server_id == r.server_id else ""}">'
+                f"<td>{_esc(s.server_id)}</td><td><code>{_esc(s.url)}</code></td></tr>"
+                for s in cfg.servers.values()
+            )
+            return 200, "text/html", _PAGE.format(
+                server_id=_esc(r.server_id),
+                configstamp=cfg.configstamp,
+                rf=cfg.rf,
+                f=cfg.f,
+                quorum=cfg.quorum,
+                member="member" if r.server_id in cfg.servers else "NOT A MEMBER",
+                member_rows=member_rows,
+                store_rows=_rows(r.store.stats()),
+                verifier_rows=_rows(verifier_stats(r.verifier)),
+                sessions=len(getattr(r, "_sessions", {})),
+                admin_gated=bool(cfg.admin_keys),
+            )
         return 404, "application/json", json.dumps({"error": "not found"})

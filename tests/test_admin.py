@@ -48,5 +48,11 @@ async def _main():
 
             status, ctype, body = await loop.run_in_executor(None, _get, port, "/")
             assert status == 200 and "text/html" in ctype and replica.server_id in body
+            # human-readable cluster view (L6 parity with the reference's
+            # static index.html): membership table with every member's URL,
+            # live store + verifier sections
+            for other in replica.config.servers.values():
+                assert other.server_id in body and other.url in body
+            assert "Membership" in body and "Store" in body and "Verifier" in body
         finally:
             await admin.close()
