@@ -209,3 +209,154 @@ def run(
 
 if __name__ == "__main__":
     print(json.dumps(run()))
+
+
+async def _open_loop_client(config_path: str, rate: float, duration_s: float):
+    """Poisson write arrivals from THIS process against an external cluster
+    (absolute-schedule pacing: a congested client loop fires missed
+    arrivals in a burst, keeping the load open-loop)."""
+    import random
+
+    from mochi_tpu.client.client import MochiDBClient
+    from mochi_tpu.client.errors import RequestRefused
+    from mochi_tpu.client.txn import TransactionBuilder
+    from mochi_tpu.server.__main__ import load_config
+
+    config = load_config(config_path)
+    client = MochiDBClient(config, timeout_s=5.0, write_attempts=8)
+    await client.execute_write_transaction(
+        TransactionBuilder().write("warm", b"w").build()
+    )
+    lat: List[float] = []
+    decision: List[float] = []  # time to completion OR typed give-up
+    gave_up = 0
+    tasks: set = set()
+    rng = random.Random(11)
+
+    async def one(i: int) -> None:
+        nonlocal gave_up
+        t0 = time.perf_counter()
+        try:
+            await client.execute_write_transaction(
+                TransactionBuilder().write(f"ol-{i}", b"v").build()
+            )
+            lat.append(time.perf_counter() - t0)
+        except (RequestRefused, TimeoutError, Exception):
+            gave_up += 1
+        decision.append(time.perf_counter() - t0)
+
+    t0 = time.perf_counter()
+    next_t = t0
+    i = 0
+    while time.perf_counter() - t0 < duration_s:
+        next_t += rng.expovariate(rate)
+        delay = next_t - time.perf_counter()
+        if delay > 0:
+            await asyncio.sleep(delay)
+        elif i % 32 == 0:
+            await asyncio.sleep(0)
+        task = asyncio.ensure_future(one(i))
+        tasks.add(task)
+        task.add_done_callback(tasks.discard)
+        i += 1
+    if tasks:
+        await asyncio.wait(tasks, timeout=20.0)
+    wall = time.perf_counter() - t0
+    await client.close()
+
+    def pct(samples, q):
+        s = sorted(samples)
+        return s[min(len(s) - 1, int(q * len(s)))] if s else float("nan")
+
+    return {
+        "offered_rate": rate,
+        "offered": i,
+        "completed": len(lat),
+        "gave_up": gave_up,
+        "goodput_per_s": round(len(lat) / wall, 1),
+        "write_p50_ms": round(pct(lat, 0.50) * 1e3, 2),
+        "write_p95_ms": round(pct(lat, 0.95) * 1e3, 2),
+        "decision_p95_ms": round(pct(decision, 0.95) * 1e3, 2),
+    }
+
+
+def run_open_loop_ab(rate: float = 500.0, duration_s: float = 10.0) -> Dict:
+    """Overload A/B in the production posture: separate replica processes
+    (each with its OWN event loop — loop lag is then a truthful per-replica
+    congestion signal, unlike the in-process harness where one loop carries
+    the whole cluster), external Poisson write load from this process.
+    Shedding off (--shed-lag-ms 0) vs on (30 ms)."""
+    from mochi_tpu.utils.runtime import tune_gc_for_server
+
+    tune_gc_for_server()
+    out: Dict = {"metric": "open_loop_overload_ab_multiproc", "unit": "ms (write p95)"}
+    for label, shed in (("unprotected", 0.0), ("protected", 30.0)):
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
+        )
+        procs: List[Tuple[str, subprocess.Popen]] = []
+        with tempfile.TemporaryDirectory(prefix="mochi-ol-") as outdir:
+            subprocess.run(
+                [
+                    sys.executable, "-m", "mochi_tpu.tools.gen_cluster",
+                    "--out-dir", outdir, "--servers", "5", "--rf", "4",
+                    "--base-port", "9501",
+                ],
+                check=True, env=env, capture_output=True,
+            )
+            cfg = os.path.join(outdir, "cluster_config.json")
+            try:
+                vport = 11511
+                procs.append((
+                    "verifier",
+                    subprocess.Popen(
+                        [
+                            sys.executable, "-m", "mochi_tpu.verifier.service",
+                            "--port", str(vport), "--backend", "cpu", "--warmup", "",
+                        ],
+                        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                    ),
+                ))
+                for i in range(5):
+                    procs.append((
+                        f"server-{i}",
+                        subprocess.Popen(
+                            [
+                                sys.executable, "-m", "mochi_tpu.server",
+                                "--config", cfg,
+                                "--server-id", f"server-{i}",
+                                "--seed-file", os.path.join(outdir, f"server-{i}.seed"),
+                                "--verifier", f"remote:127.0.0.1:{vport}",
+                                "--shed-lag-ms", str(shed),
+                            ],
+                            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                        ),
+                    ))
+                from mochi_tpu.server.__main__ import load_config
+
+                config = load_config(cfg)
+                import socket
+
+                deadline = time.time() + 30
+                for info in config.servers.values():
+                    while time.time() < deadline:
+                        try:
+                            with socket.create_connection((info.host, info.port), 0.5):
+                                break
+                        except OSError:
+                            time.sleep(0.2)
+                    else:
+                        raise RuntimeError("cluster did not come up")
+                out[label] = asyncio.run(_open_loop_client(cfg, rate, duration_s))
+            finally:
+                for _, p in procs:
+                    p.send_signal(signal.SIGTERM)
+                for _, p in procs:
+                    try:
+                        p.wait(timeout=5)
+                    except subprocess.TimeoutExpired:
+                        p.kill()
+    out["value"] = out["protected"]["write_p95_ms"]
+    return out

@@ -41,6 +41,7 @@ def _run_child(key: str) -> None:
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
     import importlib
 
+    os.environ.setdefault("MOCHI_BENCH_FULL", "1")  # battery: full evidence
     mod = importlib.import_module(f"benchmarks.{CONFIG_NAMES[key]}")
     rec = mod.run()
     rec["config"] = key

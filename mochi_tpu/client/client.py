@@ -538,6 +538,7 @@ class MochiDBClient:
             txn_hash = transaction_hash(transaction)
             write1_txn = self._write1_transaction(transaction)
             refusals = 0
+            all_shed_rounds = 0
             for attempt in range(self.write_attempts):
                 seed = self._rand.randrange(SEED_RANGE)
                 # Grants only need a timestamp-consistent 2f+1 subset, so the
@@ -574,6 +575,42 @@ class MochiDBClient:
                     # the root of its historical trust chain.
                     chosen = self._trim_to_quorum_cover(transaction, chosen)
                 if chosen is None:
+                    shed = sum(
+                        1
+                        for p in responses.values()
+                        if isinstance(p, RequestFailedFromServer)
+                        and p.fail_type == FailType.OVERLOADED
+                    )
+                    if shed:
+                        # Admission control turned us away — this is flow
+                        # control, not refusal: exponential jittered backoff
+                        # (the explicit retry-with-backoff contract of
+                        # FailType.OVERLOADED), and it doesn't burn the
+                        # refusal budget.  Three consecutive fully-shed
+                        # rounds mean hard overload: surface it as a typed
+                        # failure in bounded time instead of hammering an
+                        # already-saturated cluster with retries (every
+                        # retry is 2(rf) more messages the cluster must
+                        # shed again).
+                        self.metrics.mark("client.write1-shed")
+                        if shed >= len(responses) and len(responses) > 0:
+                            all_shed_rounds += 1
+                            # 5 consecutive fully-shed rounds: at moderate
+                            # shed probabilities a spurious give-up is then
+                            # <1% (draws are per-attempt), while hard
+                            # overload (p~0.9) still fails in ~1s of backoff
+                            if all_shed_rounds >= 5:
+                                raise RequestRefused(
+                                    "cluster overloaded: write shed by "
+                                    f"admission control {all_shed_rounds}x"
+                                )
+                        else:
+                            all_shed_rounds = 0
+                        await asyncio.sleep(
+                            0.02 * (1 << min(attempt, 4)) * (0.5 + self._rand.random())
+                        )
+                        continue
+                    all_shed_rounds = 0
                     # Seed collision with another in-flight transaction,
                     # missing responses, or split timestamps: back off and
                     # retry with a fresh seed

@@ -49,6 +49,7 @@ class FailType(IntEnum):
     BAD_SIGNATURE = 1  # new: message failed signature verification
     BAD_CERTIFICATE = 2  # new: write certificate failed quorum/signature checks
     BAD_REQUEST = 3  # new: request failed input validation (e.g. seed range)
+    OVERLOADED = 4  # new: admission control shed this request; retry with backoff
 
 
 # --------------------------------------------------------------------------

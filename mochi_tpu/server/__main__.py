@@ -90,6 +90,7 @@ async def amain(args) -> None:
         port=info.port,
         snapshot_path=snapshot_path,
         snapshot_interval_s=args.snapshot_interval,
+        shed_lag_ms=args.shed_lag_ms,
     )
     await replica.start()
     if args.resync_on_boot:
@@ -169,6 +170,13 @@ def main(argv=None) -> None:
         "(register via the _CONFIG_CLIENT_<id> keyspace, "
         "MochiDBClient.register_client_key; admin-gated when "
         "config.admin_keys is set)",
+    )
+    parser.add_argument(
+        "--shed-lag-ms",
+        type=float,
+        default=30.0,
+        help="overload admission control: shed new Write1s when event-loop "
+        "lag EWMA exceeds this (0 disables)",
     )
     parser.add_argument("--log-level", default="INFO")
     args = parser.parse_args(argv)

@@ -36,12 +36,14 @@ class VirtualCluster:
         verifier_factory: Optional[Callable[[], SignatureVerifier]] = None,
         require_client_auth: bool = False,
         host: str = "127.0.0.1",
+        shed_lag_ms: float = 30.0,
     ):
         self.n_servers = n_servers
         self.rf = rf
         self.verifier_factory = verifier_factory
         self.require_client_auth = require_client_auth
         self.host = host
+        self.shed_lag_ms = shed_lag_ms
         self.replicas: List[MochiReplica] = []
         self.keypairs: Dict[str, KeyPair] = {}
         self.config: Optional[ClusterConfig] = None
@@ -82,6 +84,7 @@ class VirtualCluster:
                 require_client_auth=self.require_client_auth,
                 host=self.host,
                 port=0,
+                shed_lag_ms=self.shed_lag_ms,
             )
             await replica.start()
             self.replicas.append(replica)

@@ -1,0 +1,128 @@
+"""Admission control (overload shedding) mechanics.
+
+The policy under test (``replica.py``): an event-loop lag monitor drives a
+proportional shed probability; Write1s are shed by a DETERMINISTIC draw
+keyed on (client_id, seed) so every replica sheds the same transactions
+(independent coin flips would collapse the 2f+1 grant quorum); Write2 and
+reads are never shed (admitted work drains); admin ops are never shed; the
+client treats OVERLOADED as flow control (jittered backoff, no refusal
+budget burned) and surfaces hard overload as a typed failure in bounded
+time.  The reference has no admission control (``MochiServer.java:36-54``
+just queues).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from mochi_tpu.client.errors import RequestRefused
+from mochi_tpu.client.txn import TransactionBuilder
+from mochi_tpu.protocol.messages import FailType, RequestFailedFromServer
+from mochi_tpu.testing.virtual_cluster import VirtualCluster
+
+
+def test_forced_shed_bounces_writes_and_client_fails_fast():
+    """With every replica's shed probability pinned to 1.0, writes must be
+    shed cluster-wide and the client must fail with a typed RequestRefused
+    quickly (3 all-shed rounds), not burn its whole retry budget."""
+
+    async def main():
+        async with VirtualCluster(5, rf=4) as vc:
+            client = vc.client(timeout_s=5.0)
+            # establish sessions + working baseline
+            await client.execute_write_transaction(
+                TransactionBuilder().write("k", b"v").build()
+            )
+            for r in vc.replicas:
+                r._shed_p = 1.0
+                if r._lag_task is not None:  # freeze the controller
+                    r._lag_task.cancel()
+            t0 = asyncio.get_event_loop().time()
+            with pytest.raises(RequestRefused, match="overloaded"):
+                await client.execute_write_transaction(
+                    TransactionBuilder().write("k2", b"v").build()
+                )
+            elapsed = asyncio.get_event_loop().time() - t0
+            assert elapsed < 4.0, f"give-up took {elapsed:.1f}s — not bounded"
+            sheds = sum(
+                r.metrics.counters.get("replica.write1-shed", 0) for r in vc.replicas
+            )
+            assert sheds >= 5 * 4  # >= 5 rounds x replica-set fan-out
+            # reads are never shed: admitted work still completes
+            res = await client.execute_read_transaction(
+                TransactionBuilder().read("k").build()
+            )
+            assert res.operations[0].value == b"v"
+
+    asyncio.run(main())
+
+
+def test_partial_shed_retries_through():
+    """At a moderate shed probability the client's keyed-draw retries (fresh
+    seed = fresh draw) must get the write through without an error."""
+
+    async def main():
+        async with VirtualCluster(5, rf=4) as vc:
+            client = vc.client(timeout_s=5.0)
+            await client.execute_write_transaction(
+                TransactionBuilder().write("k", b"v").build()
+            )
+            for r in vc.replicas:
+                r._shed_p = 0.3
+                if r._lag_task is not None:
+                    r._lag_task.cancel()
+            for i in range(6):
+                await client.execute_write_transaction(
+                    TransactionBuilder().write(f"p{i}", b"x").build()
+                )
+            res = await client.execute_read_transaction(
+                TransactionBuilder().read("p5").build()
+            )
+            assert res.operations[0].value == b"x"
+
+    asyncio.run(main())
+
+
+def test_shed_draw_is_identical_across_replicas():
+    """The admission draw is a pure function of (client_id, seed): replicas
+    agree exactly, which is what keeps quorums alive under shedding."""
+    from mochi_tpu.server.replica import MochiReplica
+
+    class P:
+        client_id = "client-abc"
+        seed = 123456
+
+    d = MochiReplica._shed_draw(P())
+    assert 0.0 <= d < 1.0
+    assert d == MochiReplica._shed_draw(P())
+    P.seed = 123457
+    assert d != MochiReplica._shed_draw(P())
+
+
+def test_admin_ops_never_shed():
+    """An operator reconfiguring an overloaded cluster must get through:
+    admin-gated writes bypass admission control."""
+
+    async def main():
+        from mochi_tpu.crypto.keys import generate_keypair
+
+        admin_kp = generate_keypair()
+        async with VirtualCluster(5, rf=4) as vc:
+            for r in vc.replicas:
+                r.config.admin_keys.append(admin_kp.public_key)
+                r._shed_p = 1.0
+                if r._lag_task is not None:
+                    r._lag_task.cancel()
+            client = vc.client(keypair=admin_kp)
+            # _CONFIG_ keyspace write = admin op; must commit despite p=1.0
+            from mochi_tpu.cluster.config import CONFIG_CLIENT_PREFIX
+
+            await client.execute_write_transaction(
+                TransactionBuilder()
+                .write(CONFIG_CLIENT_PREFIX + "ops-client", b"\x01" * 32)
+                .build()
+            )
+
+    asyncio.run(main())
