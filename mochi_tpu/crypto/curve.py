@@ -50,6 +50,16 @@ MOSAIC_SAFE = False
 # Measured on v5e with scripts/unroll_bench.py before changing.
 LADDER_UNROLL = 1
 
+# Table-select formulation (MOCHI_SELECT_IMPL):
+#   "stacked"   — ONE masked 9-entry sum per table over the coords
+#                 concatenated on the limb axis ((9, 68|51, lanes)): 9 adds
+#                 + 9 selects per lookup instead of 63 per-coordinate op
+#                 chains; fewer HLO ops for the scheduler to place.
+#   "per-coord" — round-2 form (one masked sum per coordinate array).
+import os as _os
+
+SELECT_IMPL = _os.environ.get("MOCHI_SELECT_IMPL", "stacked")
+
 
 class Point(NamedTuple):
     """Extended coordinates (X : Y : Z : T), x=X/Z, y=Y/Z, T=XY/Z.
@@ -243,13 +253,46 @@ def select_entry(table, idx: jnp.ndarray, n_entries: int):
     ``(n_entries, 17, lanes)`` (or broadcastable); ``idx``: (lanes,) int32.
     Data-dependent per-lane gathers don't vectorize on the VPU; n_entries
     masked adds do.
+
+    With ``SELECT_IMPL == "stacked"`` the coordinate arrays are
+    concatenated on the limb axis first, so the whole lookup is ONE
+    9-term masked sum over a (n_entries, n_coords*17, lanes) array —
+    9 where+add pairs instead of 9*n_coords — then split back.
     """
+    if SELECT_IMPL == "stacked" and len(table) > 1:
+        stacked, widths = stack_table(table, n_entries, idx.shape)
+        return select_entry_stacked(stacked, widths, idx, n_entries)
     out = []
     for coord in table:
         acc = jnp.zeros_like(coord[0] + jnp.zeros_like(idx))
         for e in range(n_entries):
             acc = acc + jnp.where((idx == e)[None], coord[e], 0)
         out.append(acc)
+    return tuple(out)
+
+
+def stack_table(table, n_entries: int, lanes):
+    """Concatenate a table's coordinate arrays on the limb axis (hoist this
+    OUTSIDE the ladder loop — the concat would otherwise re-materialize
+    every iteration)."""
+    widths = [c.shape[1] for c in table]
+    stacked = jnp.concatenate(
+        [jnp.broadcast_to(c, (n_entries, c.shape[1], *lanes)) for c in table],
+        axis=1,
+    )
+    return stacked, widths
+
+
+def select_entry_stacked(stacked, widths, idx: jnp.ndarray, n_entries: int):
+    """One masked n_entries-term sum over the stacked array, then split."""
+    acc = jnp.zeros(stacked.shape[1:], stacked.dtype)
+    for e in range(n_entries):
+        acc = acc + jnp.where((idx == e)[None], stacked[e], 0)
+    out = []
+    off = 0
+    for w in widths:
+        out.append(acc[off : off + w])
+        off += w
     return tuple(out)
 
 
@@ -325,17 +368,37 @@ def double_scalar_mul_windowed(
         def digit_at(dig, w):
             return lax.dynamic_index_in_dim(dig, w, axis=0, keepdims=False)
 
+    if SELECT_IMPL == "stacked":
+        # Hoisted once; each ladder iteration then does ONE 9-term masked
+        # sum per table instead of one per coordinate array.
+        a_stacked, a_widths = stack_table(a_tab, N_TABLE, lanes)
+        b_stacked, b_widths = stack_table(b_tab, N_TABLE, lanes)
+
+        def a_select(idx):
+            return select_entry_stacked(a_stacked, a_widths, idx, N_TABLE)
+
+        def b_select(idx):
+            return select_entry_stacked(b_stacked, b_widths, idx, N_TABLE)
+
+    else:
+
+        def a_select(idx):
+            return select_entry(a_tab, idx, N_TABLE)
+
+        def b_select(idx):
+            return select_entry(b_tab, idx, N_TABLE)
+
     def body(i, q):
         w = 63 - i
         q = double(double(double(double(Point(*q)))))
-        ex, ey, ez, et = select_entry(a_tab, digit_at(p_mag, w), N_TABLE)
+        ex, ey, ez, et = a_select(digit_at(p_mag, w))
         pn = digit_at(p_neg.astype(jnp.int32), w).astype(bool)
         # negative digit: -(x, y, z, t) = (-x, y, z, -t), branchless
         entry = Point(
             F.select(pn, F.neg(ex), ex), ey, ez, F.select(pn, F.neg(et), et)
         )
         q = add(q, entry)
-        nypx, nymx, nxy2d = select_entry(b_tab, digit_at(s_mag, w), N_TABLE)
+        nypx, nymx, nxy2d = b_select(digit_at(s_mag, w))
         sn = digit_at(s_neg.astype(jnp.int32), w).astype(bool)
         # Niels negation: swap (y+x)/(y-x), negate xy2d
         nypx, nymx = (

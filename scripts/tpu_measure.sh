@@ -50,6 +50,29 @@ print(f"MAX_BUCKET={mb}: {mb/dt:.1f} sigs/s ({dt*1e3:.1f} ms)")
 EOF
 done
 
+echo "== 3b. select-impl A/B (stacked vs per-coord masked table lookups)" | tee -a "$OUT"
+for impl in stacked per-coord; do
+  MOCHI_SELECT_IMPL=$impl timeout 900 python - <<'EOF' 2>&1 | tee -a "$OUT"
+import os, time, numpy as np, jax
+jax.config.update("jax_compilation_cache_dir", ".jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+from mochi_tpu.crypto import batch_verify, keys
+from mochi_tpu.verifier.spi import VerifyItem
+kp = keys.generate_keypair()
+n = batch_verify.MAX_BUCKET
+items = [VerifyItem(kp.public_key, b"s%d" % i, kp.sign(b"s%d" % i)) for i in range(n)]
+batch_verify.verify_batch(items)  # compile + warm
+best = 0.0
+for _ in range(3):
+    t0 = time.perf_counter()
+    out = batch_verify.verify_batch(items)
+    dt = time.perf_counter() - t0
+    best = max(best, n / dt)
+assert all(out)
+print(f"SELECT_IMPL={os.environ['MOCHI_SELECT_IMPL']}: best {best:.1f} sigs/s at batch {n}")
+EOF
+done
+
 echo "== 4. publish all configs" | tee -a "$OUT"
 MOCHI_BENCH_ROUND="$ROUND" timeout 5400 python -m benchmarks.run_all --publish 2>&1 | tee -a "$OUT"
 
