@@ -122,10 +122,13 @@ def run(n: int = 16, f: int = 5, n_ops: int = 2048, batch: int = 4096) -> Dict:
 def run_cluster_ycsb(
     n_clients: int = 5, n_ops_per_client: int = 60, n_keys: int = 64
 ):
-    """YCSB-A through the REAL cluster: 50% reads / 50% updates over a
-    zipfian key distribution, 5 concurrent clients against a 5-replica
-    virtual cluster (rf=4, full signing).  Complements the device-side
-    aggregation microbench above with protocol-inclusive numbers."""
+    """YCSB-A through the REAL cluster in the production verify posture:
+    50% reads / 50% updates over a zipfian key distribution, 5 concurrent
+    clients against a 5-replica virtual cluster (rf=4, full signing), with
+    every replica shipping its signature batches to ONE shared verifier
+    service over the mcode RPC — the service runs the TPU batch verifier
+    when a chip is present (client→replica→service→device end-to-end,
+    VERDICT r2 item 6), and the batching+memoizing CPU path otherwise."""
     import asyncio
     import time as _time
 
@@ -137,11 +140,36 @@ def run_cluster_ycsb(
         from config1_cluster import _pct
     from mochi_tpu.client.txn import TransactionBuilder
     from mochi_tpu.testing.virtual_cluster import VirtualCluster
+    from mochi_tpu.verifier.service import RemoteVerifier, VerifierService
+    from mochi_tpu.verifier.spi import CpuVerifier
 
     rng = np.random.default_rng(4242)
 
     async def amain():
-        async with VirtualCluster(5, rf=4) as vc:
+        inner = None
+        platform = "cpu-service"
+        try:
+            import jax
+
+            if jax.default_backend() == "tpu":
+                from mochi_tpu.verifier.tpu import TpuBatchVerifier
+
+                inner = TpuBatchVerifier(max_delay_s=0.001, warmup_buckets=(16,))
+                platform = "tpu-service"
+        except Exception:
+            inner = None
+        if inner is None:
+            inner = CpuVerifier()
+        service = VerifierService(port=0, verifier=inner)
+        await service.start()
+        factory = lambda: RemoteVerifier("127.0.0.1", service.bound_port)
+        try:
+            return await _ycsb_cluster(factory, platform, service)
+        finally:
+            await service.close()
+
+    async def _ycsb_cluster(factory, platform, service):
+        async with VirtualCluster(5, rf=4, verifier_factory=factory) as vc:
             # preload the keyspace so reads hit existing keys — batched
             # into multi-write transactions (16 keys each) instead of 64
             # sequential round trips of untimed setup
@@ -189,11 +217,12 @@ def run_cluster_ycsb(
                 "ops": ops,
                 "zipf_keys": n_keys,
                 # provenance emitted by the harness so --publish republishes
-                # it instead of dropping hand-edits (replicas here run the
-                # inline CPU verifier — the reference-analog path)
-                "platform": (
-                    "inline CPU verifier; 5-replica virtual cluster, rf=4, "
-                    "full signing"
+                # it instead of dropping hand-edits
+                "platform": platform,
+                "service_items": service.items,
+                "topology": (
+                    "client -> replica -> shared verifier service -> device; "
+                    "5-replica virtual cluster, rf=4, full signing"
                 ),
             }
 
