@@ -178,6 +178,12 @@ def run(
             for k, v in run_open_loop(rate=500.0, duration_s=8.0).items()
             if k in ("unprotected", "protected")
         }
+        try:
+            from benchmarks import config1_multiproc
+
+            rec["multiproc"] = config1_multiproc.run(5, 4, 8, 20, 3)
+        except Exception as exc:
+            rec["multiproc"] = {"error": f"{type(exc).__name__}: {exc}"[:200]}
         rec["open_loop_note"] = (
             "in-process harness: ONE event loop carries all 5 replicas + "
             "clients + service, so the lag signal every replica sheds on is "
