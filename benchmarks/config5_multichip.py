@@ -55,7 +55,7 @@ def run(batch_per_device: int = 2048, n_groups: int = 64, iters: int = 3) -> Dic
         out = tuple(np.asarray(x) for x in step(*arrays, thr))
         best = min(best, time.perf_counter() - t0)
 
-    return {
+    rec = {
         "metric": "multichip_sharded_verify_throughput",
         "value": round(b / best, 1),
         "unit": "sigs/sec",
@@ -64,6 +64,52 @@ def run(batch_per_device: int = 2048, n_groups: int = 64, iters: int = 3) -> Dic
         "batch_total": b,
         "ms": round(best * 1e3, 2),
     }
+
+    # Same-batch A/B (VERDICT r2 weak #6/item 7): decompose the round-2
+    # gap (7.8k sigs/s sharded@2048 vs 91k unsharded@8192 on one chip)
+    # into its two factors —
+    #   batch-size effect:      unsharded@2048 vs unsharded@8192
+    #   shard_map/psum tax:     sharded@B vs unsharded@B, same B
+    try:
+        from mochi_tpu.crypto.curve import verify_prepared
+
+        fn = jax.jit(verify_prepared)
+        ab: Dict = {}
+        for bsz in sorted({b, 8192}):
+            kp2_items = items
+            while len(kp2_items) < bsz:
+                msg = b"ab %d" % len(kp2_items)
+                kp2_items = kp2_items + [
+                    VerifyItem(kp.public_key, msg, kp.sign(msg))
+                ]
+            prep_b = batch_verify.prepare(kp2_items[:bsz])
+            args_u = tuple(prep_b[:6])
+            jax.block_until_ready(fn(*args_u))  # compile
+            t_u = float("inf")
+            for _ in range(iters):
+                t0 = time.perf_counter()
+                np.asarray(fn(*args_u))
+                t_u = min(t_u, time.perf_counter() - t0)
+            gid = (np.arange(bsz, dtype=np.int32) % n_groups).astype(np.int32)
+            arr_s, _ = pad_to_multiple(
+                tuple(prep_b[:6]) + (gid,), bsz, n_dev, dead_group=0
+            )
+            step_b = make_quorum_step(mesh, n_groups)
+            jax.block_until_ready(step_b(*arr_s, thr))  # compile
+            t_s = float("inf")
+            for _ in range(iters):
+                t0 = time.perf_counter()
+                _o = tuple(np.asarray(x) for x in step_b(*arr_s, thr))
+                t_s = min(t_s, time.perf_counter() - t0)
+            ab[str(bsz)] = {
+                "unsharded_sigs_per_sec": round(bsz / t_u, 1),
+                "sharded_sigs_per_sec": round(bsz / t_s, 1),
+                "shard_machinery_tax": round(t_s / t_u, 2),
+            }
+        rec["same_batch_ab"] = ab
+    except Exception as exc:
+        rec["same_batch_ab"] = {"error": f"{type(exc).__name__}: {exc}"[:200]}
+    return rec
 
 
 if __name__ == "__main__":
