@@ -67,6 +67,10 @@ LOG = logging.getLogger(__name__)
 
 SEED_RANGE = 1000  # ref: MochiDBClient.java:262 — seed = rand.nextInt(1000)
 
+# How long a client remembers an authenticated handshake refusal before
+# trying that replica again (see MochiDBClient._session_refused).
+SESSION_REFUSAL_TTL_S = 30.0
+
 
 @dataclass
 class MochiDBClient:
@@ -95,6 +99,14 @@ class MochiDBClient:
         # fallback (and the handshake carrier) — crypto/session.py.
         self._sessions: Dict[str, bytes] = {}
         self._session_locks: Dict[str, asyncio.Lock] = {}
+        # sid -> monotonic deadline: servers that sent an AUTHENTICATED
+        # BAD_SIGNATURE handshake refusal (secure posture, identity not in
+        # that replica's registry).  Skip re-handshaking until the deadline
+        # — a TTL, because the refusal can be transient (replica restarted
+        # and not yet resynced the registry; registration committed after
+        # our first contact) and nothing bumps the configstamp in those
+        # cases.  Also cleared outright on config refresh.
+        self._session_refused: Dict[str, float] = {}
         self._read_rotor = 0
 
     # ------------------------------------------------------------ plumbing
@@ -193,6 +205,17 @@ class MochiDBClient:
             return False
         return cpu_verify(key, env.signing_bytes(), env.signature)
 
+    @staticmethod
+    def _server_signed(sid: str, server_key: bytes, env: Envelope) -> bool:
+        """One definition of "this envelope is Ed25519-signed by sid" for
+        both handshake checks (ack and typed refusal) — divergence here
+        would let one path accept what the other rejects."""
+        return (
+            env.sender_id == sid
+            and env.signature is not None
+            and cpu_verify(server_key, env.signing_bytes(), env.signature)
+        )
+
     async def _ensure_session(self, sid: str, info: ServerInfo) -> None:
         """Establish a MAC session with one server (no-op if present).
 
@@ -202,12 +225,18 @@ class MochiDBClient:
         """
         if sid in self._sessions or not self.authenticate_servers:
             return
+        if self._session_refused.get(sid, 0.0) > time.monotonic():
+            return
         server_key = self.config.public_keys.get(sid)
         if server_key is None:
             return
         lock = self._session_locks.setdefault(sid, asyncio.Lock())
         async with lock:
+            # re-check BOTH outcomes under the lock: a concurrent caller may
+            # have just established a session — or just been refused
             if sid in self._sessions:
+                return
+            if self._session_refused.get(sid, 0.0) > time.monotonic():
                 return
             hs = session_crypto.new_handshake()
             env = self._envelope(  # signed (no session yet) — must be
@@ -219,11 +248,40 @@ class MochiDBClient:
                 LOG.debug("session handshake with %s failed: %s", sid, exc)
                 return  # fall back to signed envelopes
             ack = res.payload
-            if (
-                not isinstance(ack, SessionAckFromServer)
-                or res.sender_id != sid
-                or res.signature is None
-                or not cpu_verify(server_key, res.signing_bytes(), res.signature)
+            if isinstance(ack, RequestFailedFromServer) and self._server_signed(
+                sid, server_key, res
+            ):
+                # AUTHENTICATED typed refusal (refusals to a signed
+                # handshake are themselves Ed25519-signed — _respond signs
+                # in-kind), not a forged ack: in the secure posture a
+                # replica rejects handshakes from identities it has no
+                # registered key for (e.g. an admin known only via
+                # config.admin_keys, or a replica outside the registry
+                # entry's replica set).  Expected — remember and stay on
+                # signatures (re-handshaking per request would add a signed
+                # RPC to every fan-out).  An UNSIGNED refusal falls through
+                # to the forged-ack WARNING below: suppressing sessions must
+                # cost an attacker a valid server signature.
+                if ack.fail_type != FailType.BAD_SIGNATURE:
+                    # Only identity-unknown refusals are a cacheable steady
+                    # state; anything else is unexpected — log and retry on
+                    # the next request.
+                    LOG.warning(
+                        "%s refused session handshake (%s); staying on signatures",
+                        sid,
+                        ack.fail_type.name,
+                    )
+                    return
+                LOG.debug(
+                    "%s refused session handshake (BAD_SIGNATURE: identity "
+                    "not registered there); staying on signatures for %gs",
+                    sid,
+                    SESSION_REFUSAL_TTL_S,
+                )
+                self._session_refused[sid] = time.monotonic() + SESSION_REFUSAL_TTL_S
+                return
+            if not isinstance(ack, SessionAckFromServer) or not self._server_signed(
+                sid, server_key, res
             ):
                 LOG.warning("invalid session ack from %s; staying on signatures", sid)
                 return
@@ -246,7 +304,13 @@ class MochiDBClient:
         """Fan a payload to the replica set; keep only authentic responses."""
         if targets is None:
             targets = self._targets(transaction)
-        missing = [t for t in targets if t[0] not in self._sessions]
+        now = time.monotonic()
+        missing = [
+            t
+            for t in targets
+            if t[0] not in self._sessions
+            and self._session_refused.get(t[0], 0.0) <= now
+        ]
         if missing:  # skip coroutine+gather setup on the steady-state path
             await asyncio.gather(
                 *(self._ensure_session(sid, info) for sid, info in missing)
@@ -305,10 +369,52 @@ class MochiDBClient:
                 # lags a fresh commit or times out — the full union is the
                 # authoritative attempt.
                 return await self._read_once(transaction, trim=False)
-        except InconsistentRead:
-            if transaction.keys == (CONFIG_CLUSTER_KEY,) or not await self.refresh_config():
+        except InconsistentRead as failure:
+            if transaction.keys == (CONFIG_CLUSTER_KEY,):
                 raise
-            return await self._read_once(transaction, trim=False)
+            if await self.refresh_config():
+                # A reconfiguration moved the keys (the old set answers
+                # WRONG_SHARD, so responders can even be 0): retry against
+                # the NEW replica set first — usually it answers outright.
+                try:
+                    return await self._read_once(transaction, trim=False)
+                except InconsistentRead as exc:
+                    # New members may still be syncing; fall through to the
+                    # nudge+poll recovery with the post-refresh evidence.
+                    failure = exc
+            # Recovery is only attempted when the failure is a RECOVERABLE
+            # split: a quorum of in-set replicas responded but disagreed —
+            # e.g. replicas restarted without --resync-on-boot hold nothing
+            # and outvote the survivors, or a reconfiguration added fresh
+            # members still syncing.  With fewer responders the set is
+            # simply down, and nudge+poll would only amplify outage load
+            # (an app retry loop would multiply every failed read ~4x).
+            if failure.responders < self.config.quorum:
+                raise failure
+            # The state is recoverable (paper's UptoSpeed): nudge the set
+            # to resync, then poll with backoff — the nudge is acked before
+            # the background sync worker finishes, so a single fixed sleep
+            # would race it on loaded hosts or big key sets.
+            await self._nudge_read_set(transaction)
+            last: InconsistentRead = failure
+            for delay in (0.15, 0.35, 0.8):
+                await asyncio.sleep(delay)
+                try:
+                    return await self._read_once(transaction, trim=False)
+                except InconsistentRead as exc:
+                    last = exc
+            raise last
+
+    async def _nudge_read_set(self, transaction: Transaction) -> None:
+        """Advisory resync hint to every replica of the transaction's keys
+        (an up-to-date replica treats it as a cheap no-op)."""
+        keys_by_sid: Dict[str, set] = {}
+        for op in transaction.operations:
+            for info in self.config.servers_for_key(op.key):
+                keys_by_sid.setdefault(info.server_id, set()).add(op.key)
+        await asyncio.gather(
+            *(self._send_nudge(sid, keys) for sid, keys in keys_by_sid.items())
+        )
 
     async def _read_once(
         self, transaction: Transaction, trim: bool = False
@@ -347,8 +453,11 @@ class MochiDBClient:
                     tallies[fp] = (count + 1, op_res)
                 best = max(tallies.values(), key=lambda t: t[0], default=(0, None))
                 if best[0] < self.config.quorum:
+                    responders = sum(t[0] for t in tallies.values())
                     raise InconsistentRead(
-                        f"op {i}: best agreement {best[0]} < quorum {self.config.quorum}"
+                        f"op {i}: best agreement {best[0]} < quorum "
+                        f"{self.config.quorum} ({responders} responders)",
+                        responders=responders,
                     )
                 final.append(best[1])
             return TransactionResult(tuple(final))
@@ -378,6 +487,7 @@ class MochiDBClient:
             return False
         if new_cfg.configstamp <= self.config.configstamp:
             return False
+        self._session_refused.clear()  # membership/registry may have changed
         LOG.info(
             "client adopting cluster config cs=%d (was %d)",
             new_cfg.configstamp, self.config.configstamp,
@@ -666,19 +776,20 @@ class MochiDBClient:
                     behind.setdefault(sid, set()).add(op.key)
         if not behind:
             return
+        await asyncio.gather(
+            *(self._send_nudge(sid, keys) for sid, keys in behind.items())
+        )
 
-        async def nudge(sid: str, keys: set) -> None:
-            info = self.config.servers.get(sid)
-            if info is None:
-                return
-            msg_id = new_msg_id()
-            env = self._envelope(NudgeSyncToServer(tuple(sorted(keys))), msg_id)
-            try:
-                await self.pool.send_and_receive(info, env, timeout_s=2.0)
-            except Exception:
-                pass
-
-        await asyncio.gather(*(nudge(sid, keys) for sid, keys in behind.items()))
+    async def _send_nudge(self, sid: str, keys: set) -> None:
+        info = self.config.servers.get(sid)
+        if info is None:
+            return
+        msg_id = new_msg_id()
+        env = self._envelope(NudgeSyncToServer(tuple(sorted(keys))), msg_id)
+        try:
+            await self.pool.send_and_receive(info, env, timeout_s=2.0)
+        except Exception:
+            pass
 
     async def _write2(
         self, transaction: Transaction, certificate: WriteCertificate

@@ -6,7 +6,17 @@ class MochiClientError(Exception):
 
 
 class InconsistentRead(MochiClientError):
-    """No 2f+1 agreeing read responses (ref: ``InconsistentReadException``)."""
+    """No 2f+1 agreeing read responses (ref: ``InconsistentReadException``).
+
+    ``responders``: how many in-set replicas answered the failing op —
+    the client's recovery path only attempts a nudge-resync when a quorum
+    RESPONDED but disagreed (a recoverable split); with fewer responders
+    the set is simply down and retries would only amplify outage load.
+    """
+
+    def __init__(self, msg: str, responders: int = 0):
+        super().__init__(msg)
+        self.responders = responders
 
 
 class InconsistentWrite(MochiClientError):

@@ -130,3 +130,29 @@ def test_sync_request_served_only_for_owned_committed_keys():
             assert replica.store.export_sync_entries(["nope"]) == []
 
     run(main())
+
+
+def test_read_quorum_failure_recovers_via_client_nudge():
+    """Two replicas of a key's set restart EMPTY (no --resync-on-boot):
+    the remaining holders can no longer outvote them, so the first read
+    attempt quorum-fails — the client must nudge the set to resync and
+    retry, returning the committed value instead of InconsistentRead
+    (found live in round-3 verification; reads previously never nudged)."""
+
+    async def main():
+        async with VirtualCluster(4, rf=4) as vc:
+            client = vc.client()
+            await client.execute_write_transaction(
+                TransactionBuilder().write("warm", b"v1").build()
+            )
+            r1 = await vc.restart_replica("server-0")
+            r2 = await vc.restart_replica("server-1")
+            assert r1.store.stats()["keys"] == 0
+            assert r2.store.stats()["keys"] == 0
+            # no explicit resync, no write-side nudge: the READ must recover
+            res = await client.execute_read_transaction(
+                TransactionBuilder().read("warm").build()
+            )
+            assert res.operations[0].value == b"v1"
+
+    run(main())
