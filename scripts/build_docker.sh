@@ -1,0 +1,39 @@
+#!/usr/bin/env bash
+# Build (and optionally push) the replica image — analog of the reference's
+# build_mochi_docker.sh, which tagged mochi-db:0.1.0-<commit-count> and
+# pushed to a registry (SURVEY.md §2.8).
+#
+# Usage: scripts/build_docker.sh [REGISTRY]
+#   scripts/build_docker.sh                 # local build + smoke-run
+#   scripts/build_docker.sh my.registry/ns  # build, tag, push
+set -euo pipefail
+REPO_DIR=$(cd "$(dirname "$0")/.." && pwd)
+cd "$REPO_DIR"
+
+VERSION="0.3.0-$(git rev-list --count HEAD 2>/dev/null || echo 0)"
+IMAGE="mochi-tpu:${VERSION}"
+docker build -t "$IMAGE" -t mochi-tpu:latest .
+echo "built $IMAGE"
+
+# smoke: container boots and the admin healthcheck passes (reference's
+# check_docker_run.sh analog) — needs a generated cluster dir to mount
+if [ -d cluster ]; then
+  CID=$(docker run -d \
+    -e CLUSTER_CONFIG=/config/cluster_config.json \
+    -e CLUSTER_CURRENT_SERVER=server-0 \
+    -e SEED_FILE=/config/server-0.seed \
+    -v "$PWD/cluster:/config" "$IMAGE")
+  trap 'docker rm -f "$CID" >/dev/null' EXIT
+  for _ in $(seq 1 30); do
+    H=$(docker inspect -f '{{.State.Health.Status}}' "$CID" 2>/dev/null || echo starting)
+    [ "$H" = healthy ] && break
+    sleep 2
+  done
+  echo "container health: ${H:-unknown}"
+fi
+
+if [ $# -ge 1 ]; then
+  docker tag "$IMAGE" "$1/$IMAGE"
+  docker push "$1/$IMAGE"
+  echo "pushed $1/$IMAGE"
+fi
