@@ -32,6 +32,10 @@ while true; do
     echo "[watchdog] probe $n LIVE $(date -u +%FT%TZ) — firing battery" | tee -a "$LOG"
     bash scripts/tpu_measure.sh "$ROUND" 2>&1 | tail -40 >>"$LOG"
     echo "[watchdog] battery done $(date -u +%FT%TZ) rc=$?" | tee -a "$LOG"
+    # Chip time is scarce and the tunnel dies without warning: commit the
+    # captures the moment they exist.
+    git add benchmarks/ BASELINE.json 2>/dev/null
+    git commit -q -m "TPU measurement battery r${ROUND}: live captures" 2>>"$LOG" || true
     exit 0
   fi
   echo "[watchdog] probe $n dead $(date -u +%FT%TZ)" >>"$LOG"
