@@ -249,8 +249,10 @@ def recode_signed4(dig: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
 def select_entry(table, idx: jnp.ndarray, n_entries: int):
     """Branchless per-lane table lookup: sum of masked entries.
 
-    ``table``: sequence of arrays with entry axis 0 — each
-    ``(n_entries, 17, lanes)`` (or broadcastable); ``idx``: (lanes,) int32.
+    ``table``: sequence of arrays with entry axis 0, every coordinate the
+    SAME shape ``(n_entries, 17, lanes)`` or the same lane-constant shape
+    ``(n_entries, 17, 1)`` (the stacked path concatenates them on axis 1,
+    so lane dims may not mix within one table); ``idx``: (lanes,) int32.
     Data-dependent per-lane gathers don't vectorize on the VPU; n_entries
     masked adds do.
 
@@ -274,13 +276,17 @@ def select_entry(table, idx: jnp.ndarray, n_entries: int):
 def stack_table(table, n_entries: int, lanes):
     """Concatenate a table's coordinate arrays on the limb axis (hoist this
     OUTSIDE the ladder loop — the concat would otherwise re-materialize
-    every iteration)."""
+    every iteration).
+
+    No lane broadcast here: the basepoint table's coords are (9, 17, 1)
+    lane-constants, and broadcasting them to (9, 17, B) before the concat
+    would materialize a per-lane copy of a constant table (~15 MB at
+    B=8192) that the per-coordinate form never built.  The masked select
+    broadcasts against ``idx`` lazily, exactly as before.
+    """
+    del n_entries, lanes  # shapes come from the coords themselves
     widths = [c.shape[1] for c in table]
-    stacked = jnp.concatenate(
-        [jnp.broadcast_to(c, (n_entries, c.shape[1], *lanes)) for c in table],
-        axis=1,
-    )
-    return stacked, widths
+    return jnp.concatenate(list(table), axis=1), widths
 
 
 def select_entry_stacked(stacked, widths, idx: jnp.ndarray, n_entries: int):

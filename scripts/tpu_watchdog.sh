@@ -12,6 +12,16 @@ REPO_DIR=$(cd "$(dirname "$0")/.." && pwd)
 cd "$REPO_DIR"
 ROUND=${1:-03}
 LOG="benchmarks/tpu_watchdog_r${ROUND}.log"
+PIDFILE="/tmp/mochi_tpu_watchdog_r${ROUND}.pid"
+
+# Single-instance guard: two watchdogs would fire concurrent batteries on
+# the scarce chip and race the capture commit.
+if [ -f "$PIDFILE" ] && kill -0 "$(cat "$PIDFILE")" 2>/dev/null; then
+  echo "[watchdog] already running (pid $(cat "$PIDFILE")); exiting" | tee -a "$LOG"
+  exit 0
+fi
+echo $$ >"$PIDFILE"
+trap 'rm -f "$PIDFILE"' EXIT
 
 probe() {
   timeout 150 python -u - <<'EOF' >/dev/null 2>&1
@@ -35,7 +45,8 @@ while true; do
     # Chip time is scarce and the tunnel dies without warning: commit the
     # captures the moment they exist.
     git add benchmarks/ BASELINE.json 2>/dev/null
-    git commit -q -m "TPU measurement battery r${ROUND}: live captures" 2>>"$LOG" || true
+    git commit -q -m "TPU measurement battery r${ROUND}: live captures" \
+      -- benchmarks/ BASELINE.json 2>>"$LOG" || true
     exit 0
   fi
   echo "[watchdog] probe $n dead $(date -u +%FT%TZ)" >>"$LOG"
