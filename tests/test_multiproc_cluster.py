@@ -1,0 +1,108 @@
+"""Production posture smoke: real OS processes end-to-end.
+
+Everything else in tests/ runs the in-process virtual cluster; this is the
+one test that exercises the deployment surface itself — ``gen_cluster`` →
+``python -m mochi_tpu.server`` × N + one shared verifier service process →
+client SDK from this process — so regressions in the CLI entry points,
+boot sequence, or remote-verifier wiring can't hide behind the in-process
+harness (round-3 verification ran exactly this topology by hand).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_multiprocess_cluster_end_to_end():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
+    )
+    procs = []
+    with tempfile.TemporaryDirectory(prefix="mochi-test-mp-") as out:
+        subprocess.run(
+            [
+                sys.executable, "-m", "mochi_tpu.tools.gen_cluster",
+                "--out-dir", out, "--servers", "5", "--rf", "4",
+                "--base-port", "19701",
+            ],
+            check=True, env=env, capture_output=True,
+        )
+        cfg = os.path.join(out, "cluster_config.json")
+        try:
+            vport = 19901
+            procs.append(subprocess.Popen(
+                [
+                    sys.executable, "-m", "mochi_tpu.verifier.service",
+                    "--port", str(vport), "--backend", "cpu", "--warmup", "",
+                ],
+                env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            ))
+            for i in range(5):
+                procs.append(subprocess.Popen(
+                    [
+                        sys.executable, "-m", "mochi_tpu.server",
+                        "--config", cfg,
+                        "--server-id", f"server-{i}",
+                        "--seed-file", os.path.join(out, f"server-{i}.seed"),
+                        "--verifier", f"remote:127.0.0.1:{vport}",
+                    ],
+                    env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                ))
+            from mochi_tpu.server.__main__ import load_config
+
+            config = load_config(cfg)
+            deadline = time.time() + 60
+            for info in config.servers.values():
+                while time.time() < deadline:
+                    try:
+                        with socket.create_connection((info.host, info.port), 0.5):
+                            break
+                    except OSError:
+                        time.sleep(0.2)
+                else:
+                    raise AssertionError("cluster did not come up in 60s")
+
+            async def drive():
+                from mochi_tpu.client.client import MochiDBClient
+                from mochi_tpu.client.txn import TransactionBuilder
+
+                c = MochiDBClient(config, timeout_s=8.0)
+                try:
+                    await c.execute_write_transaction(
+                        TransactionBuilder().write("mp-k", b"mp-v").build()
+                    )
+                    res = await c.execute_read_transaction(
+                        TransactionBuilder().read("mp-k").build()
+                    )
+                    assert res.operations[0].value == b"mp-v"
+                    cert = res.operations[0].current_certificate
+                    assert cert is not None and len(cert.grants) >= config.quorum
+                    await c.execute_write_transaction(
+                        TransactionBuilder().delete("mp-k").build()
+                    )
+                    res = await c.execute_read_transaction(
+                        TransactionBuilder().read("mp-k").build()
+                    )
+                    assert not res.operations[0].existed
+                finally:
+                    await c.close()
+
+            asyncio.run(drive())
+        finally:
+            for p in procs:
+                p.send_signal(signal.SIGTERM)
+            for p in procs:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
