@@ -76,14 +76,19 @@ BY_INT = 46316835694926478169428394003475163141307993866256225615783033603165251
 # relayout, fuses into the partial-product computation; measured 3.4x
 # faster than "reshape" on v5e at (17, 4096): 7.9 vs 27.0 us/mul,
 # scripts/mul_microbench.py), "reshape" (3 XLA ops but the flatten/
-# reshape is a relayout + fusion barrier on TPU), or "shift" (unrolled
+# reshape is a relayout + fusion barrier on TPU), "shift" (unrolled
 # static-slice adds — required inside Mosaic kernels, where reshapes
-# that touch the sublane dim are restricted).
-SKEW_IMPL = "pad"
+# that touch the sublane dim are restricted), or "mxu" (column reduction
+# as one f32 matmul against a constant 0/1 shift matrix — moves the
+# reduction off the VPU onto the MXU; see ``_mul_mxu``).  Env-overridable
+# for the measurement battery's A/B (MOCHI_SKEW_IMPL).
+import os as _os
+
+SKEW_IMPL = _os.environ.get("MOCHI_SKEW_IMPL", "pad")
 
 
 def available_skews():
-    return ("pad", "reshape", "shift")
+    return ("pad", "reshape", "shift", "mxu")
 
 # How to materialize limb constants: "array" (one XLA literal — default) or
 # "scalars" (per-limb jnp.full from python ints — required inside Pallas
@@ -288,9 +293,10 @@ def _skew_cols_pad(x: jnp.ndarray) -> jnp.ndarray:
 def _skew_cols(x: jnp.ndarray) -> jnp.ndarray:
     if SKEW_IMPL == "reshape":
         return _skew_cols_reshape(x)
-    if SKEW_IMPL == "pad":
-        return _skew_cols_pad(x)
-    return _skew_cols_shift(x)
+    if SKEW_IMPL == "shift":
+        return _skew_cols_shift(x)
+    # "pad" — also the fallback for "mxu" ranks the matmul path declines
+    return _skew_cols_pad(x)
 
 
 def _fold_carry(cols_lo: jnp.ndarray, cols_hi: jnp.ndarray) -> jnp.ndarray:
@@ -306,6 +312,56 @@ def _fold_carry(cols_lo: jnp.ndarray, cols_hi: jnp.ndarray) -> jnp.ndarray:
     return _carry2(folded)
 
 
+# Constant reduction matrix for the "mxu" multiply: row k sums the lo
+# products with i+j == k and the hi products with i+j+1 == k (hi is the
+# product's high half, one limb up).  Shape (34, 2*289) f32 0/1.
+def _build_mxu_matrix() -> np.ndarray:
+    m = np.zeros((2 * NLIMBS, 2 * NLIMBS * NLIMBS), dtype=np.float32)
+    for i in range(NLIMBS):
+        for j in range(NLIMBS):
+            m[i + j, i * NLIMBS + j] = 1.0
+            m[i + j + 1, NLIMBS * NLIMBS + i * NLIMBS + j] = 1.0
+    return m
+
+
+_MXU_M = _build_mxu_matrix()
+
+
+def _mul_mxu(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Column reduction on the MXU: the 17x17 partial-product anti-diagonal
+    sums are one (34, 578) x (578, B) f32 matmul against a constant 0/1
+    shift matrix.
+
+    Exactness: lo < 2^15 and hi <= 32965 < 2^16 are f32-exact; each output
+    column sums <= 34 such terms -> < 2^21 < 2^24, still exact; folded
+    < 20 * 2^21 < 2^26 -> :func:`_carry2` precondition holds.  The VPU
+    still computes the 289 int32 products; what moves to the MXU is the
+    33-way reduction tree, the schedule-heavy half of the pad-skew form.
+    Requires 1-D lanes (the batched verifier path); other ranks fall back.
+    """
+    lanes = a.shape[1:]
+    prod = a[:, None] * b[None, :]  # (17, 17, B) int32
+    lo = (prod & MASK).astype(jnp.float32).reshape(NLIMBS * NLIMBS, *lanes)
+    hi = (prod >> RADIX).astype(jnp.float32).reshape(NLIMBS * NLIMBS, *lanes)
+    p = jnp.concatenate([lo, hi], axis=0)  # (578, B)
+    # precision=HIGHEST: TPU's default f32 matmul decomposes operands
+    # through bf16 passes whose 8-bit mantissa would truncate the 16-bit
+    # product halves — exactly on the hardware this path targets (CPU's
+    # full-f32 default would mask it in tests).
+    cols = lax.dot_general(
+        jnp.asarray(_MXU_M),
+        p,
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=lax.Precision.HIGHEST,
+    )  # (34, B), exact integers < 2^21
+    # Fold in int32: 19 * col + col can reach ~21.7M > 2^24, past f32's
+    # exact-integer range (the cols themselves, < 2^21, convert exactly).
+    cols_i = cols.astype(jnp.int32)
+    folded = cols_i[:NLIMBS] + 19 * cols_i[NLIMBS:]
+    return _carry2(folded)
+
+
 def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Schoolbook 17x17-limb multiply, radix 2^15, fold at 2^255 === 19.
 
@@ -313,6 +369,12 @@ def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     (int32-safe, no uint32 casts).  lo < 2^15, hi = prod >> 15 <= 32965.
     Columns: <= 17 terms each for lo and hi -> < 2^21 -> :func:`_fold_carry`.
     """
+    if (
+        SKEW_IMPL == "mxu"
+        and CONST_MODE != "scalars"  # Mosaic kernels: no sublane reshape/dot
+        and len(a.shape) == 2 == len(b.shape)
+    ):
+        return _mul_mxu(a, b)
     prod = a[:, None] * b[None, :]  # (17, 17, lanes) int32
     lo = prod & MASK
     hi = prod >> RADIX
@@ -341,7 +403,14 @@ def square(a: jnp.ndarray) -> jnp.ndarray:
     Mosaic-mode flag: ``SKEW_IMPL == "shift"`` or ``CONST_MODE ==
     "scalars"`` — :mod:`mochi_tpu.crypto.pallas_verify` sets the latter.)
     """
-    if SKEW_IMPL == "shift" or CONST_MODE == "scalars":
+    if (
+        SKEW_IMPL == "shift"
+        or CONST_MODE == "scalars"
+        # mxu takes rank-2 squarings through the matmul reduction (the
+        # 153-product saving applies to VPU work the matmul replaces);
+        # other ranks keep the specialized symmetric schoolbook.
+        or (SKEW_IMPL == "mxu" and len(a.shape) == 2)
+    ):
         return mul(a, a)
     n = NLIMBS
     lanes = a.shape[1:]

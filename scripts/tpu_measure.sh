@@ -50,9 +50,12 @@ print(f"MAX_BUCKET={mb}: {mb/dt:.1f} sigs/s ({dt*1e3:.1f} ms)")
 EOF
 done
 
-echo "== 3b. select-impl A/B (stacked vs per-coord masked table lookups)" | tee -a "$OUT"
-for impl in stacked per-coord; do
-  MOCHI_SELECT_IMPL=$impl timeout 900 python - <<'EOF' 2>&1 | tee -a "$OUT"
+echo "== 3b. kernel-formulation A/B (select impl; MXU column-reduction multiply)" | tee -a "$OUT"
+# One shared benchmark body; each leg sets one env knob.  The headline
+# (step 2) runs the defaults; MOCHI_SKEW_IMPL=mxu is VERDICT r2 item 2's
+# matmul-reduction formulation probe.
+for leg in "MOCHI_SELECT_IMPL=stacked" "MOCHI_SELECT_IMPL=per-coord" "MOCHI_SKEW_IMPL=mxu"; do
+  env "$leg" MOCHI_AB_LEG="$leg" timeout 900 python - <<'EOF' 2>&1 | tee -a "$OUT"
 import os, time, numpy as np, jax
 jax.config.update("jax_compilation_cache_dir", ".jax_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
@@ -66,10 +69,9 @@ best = 0.0
 for _ in range(3):
     t0 = time.perf_counter()
     out = batch_verify.verify_batch(items)
-    dt = time.perf_counter() - t0
-    best = max(best, n / dt)
+    best = max(best, n / (time.perf_counter() - t0))
 assert all(out)
-print(f"SELECT_IMPL={os.environ['MOCHI_SELECT_IMPL']}: best {best:.1f} sigs/s at batch {n}")
+print(f"{os.environ['MOCHI_AB_LEG']}: best {best:.1f} sigs/s at batch {n}")
 EOF
 done
 
