@@ -51,14 +51,19 @@ MOSAIC_SAFE = False
 LADDER_UNROLL = 1
 
 # Table-select formulation (MOCHI_SELECT_IMPL):
+#   "per-coord" — round-2 form (one masked sum per coordinate array) —
+#                 the DEFAULT: it produced the measured 111k sigs/s
+#                 headline, and the headline capture must run the proven
+#                 config (chip time is scarce; a regressing default could
+#                 burn the only live window).
 #   "stacked"   — ONE masked 9-entry sum per table over the coords
 #                 concatenated on the limb axis ((9, 68|51, lanes)): 9 adds
 #                 + 9 selects per lookup instead of 63 per-coordinate op
 #                 chains; fewer HLO ops for the scheduler to place.
-#   "per-coord" — round-2 form (one masked sum per coordinate array).
+#                 Candidate, A/B'd by the measurement battery step 3b.
 import os as _os
 
-SELECT_IMPL = _os.environ.get("MOCHI_SELECT_IMPL", "stacked")
+SELECT_IMPL = _os.environ.get("MOCHI_SELECT_IMPL", "per-coord")
 
 
 class Point(NamedTuple):
