@@ -75,6 +75,9 @@ print(f"{os.environ['MOCHI_AB_LEG']}: best {best:.1f} sigs/s at batch {n}")
 EOF
 done
 
+echo "== 3c. cycle decomposition (roofline evidence for the MFU story)" | tee -a "$OUT"
+timeout 1200 python scripts/roofline.py 8192 2>&1 | tee -a "$OUT"
+
 echo "== 4. publish all configs" | tee -a "$OUT"
 MOCHI_BENCH_ROUND="$ROUND" timeout 5400 python -m benchmarks.run_all --publish 2>&1 | tee -a "$OUT"
 
