@@ -377,8 +377,16 @@ class JaxBatchBackend:
         self,
         device: Optional[jax.Device] = None,
         min_device_items: Optional[int] = None,
+        verify_fn=None,
     ):
         self.device = device
+        # Hook for alternative device paths (the mesh-sharded backend in
+        # verifier/tpu.py) so they inherit the crossover + warmup +
+        # compile-stall machinery below instead of re-implementing it.
+        # Same contract as verify_batch(items, device=..., bucket=...).
+        # None means "the module's verify_batch", resolved at CALL time so
+        # tests that monkeypatch the module function still intercept.
+        self._verify_fn = verify_fn
         # CPU/device crossover: a device launch costs a fixed round trip
         # (~100 ms through the axon tunnel; ~1 ms on an attached chip),
         # while OpenSSL verifies ~0.18 ms/sig on this host — so batches
@@ -397,11 +405,15 @@ class JaxBatchBackend:
         self._failed: set[int] = set()
         self._lock = threading.Lock()
 
+    def _call_verify(self, items, bucket: Optional[int] = None):
+        fn = self._verify_fn if self._verify_fn is not None else verify_batch
+        return fn(items, device=self.device, bucket=bucket)
+
     def warmup(self, batch_sizes: Sequence[int]) -> None:
         """Synchronously pre-compile the given bucket sizes (boot path)."""
         for n in batch_sizes:
             bucket = _bucket_size(n)
-            verify_batch(_dummy_items(bucket), device=self.device)
+            self._call_verify(_dummy_items(bucket))
             with self._lock:
                 self._ready.add(bucket)
 
@@ -409,7 +421,7 @@ class JaxBatchBackend:
         def run():
             try:
                 items = _dummy_items(bucket)
-                verify_batch(items, device=self.device)
+                self._call_verify(items)
                 with self._lock:
                     self._ready.add(bucket)
             except Exception:
@@ -450,7 +462,7 @@ class JaxBatchBackend:
             # Bucket compiled, or nothing compiled yet (first ever call):
             # run directly (the latter eats one synchronous compile — servers
             # avoid it via boot-time warmup).
-            out = verify_batch(items, device=self.device)
+            out = self._call_verify(items)
             with self._lock:
                 self._ready.add(bucket)
             return out
@@ -464,7 +476,7 @@ class JaxBatchBackend:
         for i in range(0, len(items), largest_ready):
             chunk = items[i : i + largest_ready]
             target = next(b for b in ready if b >= len(chunk))
-            out.extend(verify_batch(chunk, device=self.device, bucket=target))
+            out.extend(self._call_verify(chunk, bucket=target))
         return out
 
 

@@ -267,6 +267,18 @@ async def amain(args) -> None:
             warmup_buckets=tuple(int(b) for b in args.warmup.split(",") if b)
         )
         LOG.info("device warmup took %.1fs", time.time() - t0)
+    elif args.backend == "tpu-sharded":
+        from .tpu import ShardedTpuBatchVerifier
+
+        t0 = time.time()
+        verifier = ShardedTpuBatchVerifier(
+            warmup_buckets=tuple(int(b) for b in args.warmup.split(",") if b)
+        )
+        LOG.info(
+            "sharded verifier over %d devices (warmup %.1fs)",
+            verifier.backend.n_devices,
+            time.time() - t0,
+        )
     secret = None
     if args.secret_file:
         secret = load_secret(args.secret_file)
@@ -308,7 +320,13 @@ def main(argv=None) -> None:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=18200)
-    parser.add_argument("--backend", choices=("tpu", "cpu"), default="tpu")
+    parser.add_argument(
+        "--backend",
+        choices=("tpu", "tpu-sharded", "cpu"),
+        default="tpu",
+        help="tpu: single-device batch verifier; tpu-sharded: shard batches "
+        "over ALL local devices (multi-chip hosts); cpu: OpenSSL",
+    )
     parser.add_argument(
         "--warmup",
         default="16,256",

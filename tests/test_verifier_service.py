@@ -223,3 +223,41 @@ def test_service_status_counters_and_admin_endpoint():
             await svc.close()
 
     run(main())
+
+
+def test_sharded_backend_over_cpu_mesh():
+    """ShardedTpuBatchVerifier splits a mixed batch over the 8-device CPU
+    mesh (conftest forces it) and returns the same bitmap the CPU verifier
+    would — the production multi-chip path, not just the benchmark one."""
+    import asyncio
+
+    from mochi_tpu.crypto import keys
+    from mochi_tpu.verifier.spi import VerifyItem
+    from mochi_tpu.verifier.tpu import ShardedTpuBatchVerifier
+
+    kp = keys.generate_keypair()
+    items = []
+    expect = []
+    for i in range(50):
+        msg = b"sh%d" % i
+        sig = kp.sign(msg)
+        if i % 6 == 2:
+            sig = bytes([sig[0] ^ 1]) + sig[1:]
+            expect.append(False)
+        else:
+            expect.append(True)
+        items.append(VerifyItem(kp.public_key, msg, sig))
+
+    async def main():
+        # min_device_items=0: force the mesh path (the inherited CPU
+        # crossover would otherwise route this small batch to OpenSSL and
+        # the test would never exercise shard_map)
+        v = ShardedTpuBatchVerifier(max_delay_s=0.001, min_device_items=0)
+        try:
+            assert v.backend.n_devices == 8
+            out = await v.verify_batch(items)
+            assert out == expect
+        finally:
+            await v.close()
+
+    asyncio.run(main())
