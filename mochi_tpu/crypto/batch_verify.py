@@ -22,6 +22,18 @@ program shapes, then caches (SURVEY.md §7: static shapes; first compile
 The verdict matches the CPU path (OpenSSL cofactorless verify) bit-for-bit;
 ``tests/test_crypto_jax.py`` checks this differentially including forged and
 malformed inputs.
+
+Considered and rejected: random-linear-combination batch verification
+(one multi-scalar-mul checking sum_i z_i*(S_i*B - R_i - h_i*A_i) = 0, as
+surveyed for committee consensus in arXiv:2302.00418).  It cuts device
+FLOPs ~2x, but (a) its cofactored acceptance can DISAGREE with
+cofactorless per-signature verification on adversarial mixed-order /
+non-canonical inputs — breaking this module's bit-for-bit differential
+contract with OpenSSL, which the cluster's Byzantine tests rely on; and
+(b) a failed batch yields no per-item verdicts, forcing bisection retries
+exactly when an attacker salts batches with one bad signature.  Batching
+here means SIMD over independent per-signature checks: same verdicts as
+serial verification, per-item bitmaps, no degradation under attack.
 """
 
 from __future__ import annotations
