@@ -316,6 +316,8 @@ def _prep_pool() -> ThreadPoolExecutor:
 def _readback(launched, n: int) -> List[bool]:
     """Block on one launched chunk and combine with its host prechecks."""
     bitmap_dev, pre_ok = launched
+    if bitmap_dev is None:  # all-rejected chunk: no device work was done
+        return [False] * n
     bitmap = np.asarray(bitmap_dev)[:n]
     return [bool(b) for b in np.logical_and(bitmap, pre_ok)]
 
@@ -345,8 +347,18 @@ def _prepare_padded(items: Sequence[VerifyItem], bucket: Optional[int]):
 
 
 def _dispatch(prepared, device: Optional[jax.Device] = None):
-    """Device half of a launch: transfer + async dispatch (main thread)."""
+    """Device half of a launch: transfer + async dispatch (main thread).
+
+    A chunk whose prechecks rejected EVERY item (e.g. a flood of
+    non-canonical garbage) skips the device entirely — an attacker must
+    spend real signing-grade work (canonical encodings) to buy device
+    time; byte noise is absorbed at host precheck rates
+    (scripts/forgery_bench.py measures both)."""
+    global _device_dispatches
     use_pallas, args, pre_ok = prepared
+    if not pre_ok.any():
+        return None, pre_ok
+    _device_dispatches += 1
     if device is not None:
         args = tuple(jax.device_put(a, device) for a in args)
     if use_pallas:
@@ -354,6 +366,18 @@ def _dispatch(prepared, device: Optional[jax.Device] = None):
 
         return pallas_verify.verify_prepared_pallas(*args), pre_ok
     return _verify_packed_jit(*args), pre_ok
+
+
+# Monotone count of real device dispatches.  JaxBatchBackend uses it to
+# tell "this call compiled/ran the bucket's program" from "the all-rejected
+# fast path skipped the device" — marking a bucket ready without a compile
+# would park the NEXT legitimate batch behind a synchronous 20-60 s compile
+# (the stall the ready/chunking machinery exists to prevent).
+_device_dispatches = 0
+
+
+def device_dispatch_count() -> int:
+    return _device_dispatches
 
 
 def _launch(
@@ -473,10 +497,15 @@ class JaxBatchBackend:
         if ready_now or not ready:
             # Bucket compiled, or nothing compiled yet (first ever call):
             # run directly (the latter eats one synchronous compile — servers
-            # avoid it via boot-time warmup).
+            # avoid it via boot-time warmup).  Only a call that actually
+            # dispatched the device program proves the bucket is compiled;
+            # the all-rejected fast path skips the device and must not mark
+            # readiness.
+            before = device_dispatch_count()
             out = self._call_verify(items)
-            with self._lock:
-                self._ready.add(bucket)
+            if device_dispatch_count() > before:
+                with self._lock:
+                    self._ready.add(bucket)
             return out
         if schedule:
             self._compile_in_background(bucket)

@@ -97,6 +97,12 @@ class ShardedJaxBatchBackend(JaxBatchBackend):
         if not items:
             return []
         y_a, sign_a, y_r, sign_r, s_sc, h_sc, pre_ok = batch_verify.prepare_packed(items)
+        if not pre_ok.any():
+            # All-rejected chunk (garbage flood): no device work, and —
+            # like the base _dispatch fast path — no dispatch-count bump,
+            # so the bucket is not falsely marked compiled.
+            return [False] * len(items)
+        batch_verify._device_dispatches += 1
         n = len(items)
         m = batch_verify._bucket_size(n) if bucket is None else bucket
         # static shapes for the compile cache, rounded up to a device

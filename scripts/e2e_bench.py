@@ -1,0 +1,106 @@
+"""End-to-end vs pipelined-steady-state gap (VERDICT r3 item 4).
+
+Round-2 measured 69.8k sigs/s end-to-end on 64k items against a 111k
+pipelined steady state (63%); the prepare-thread overlap
+(batch_verify._prep_pool) landed after that capture and has never run on
+the chip.  This measures both rates in one process, same buffers:
+
+* pipelined: D batches of MAX_BUCKET in flight over the SAME prepared
+  arrays (device time + tunnel RTT only — the ceiling);
+* end-to-end: ``verify_batch`` on a fresh 64k item list (host prepare +
+  H2D + device + readback through the chunked pipeline — the real
+  service path).
+
+Goal: end-to-end >= 90% of pipelined.  If the gap persists, the
+per-phase timings printed below name the residual.
+
+Usage: python scripts/e2e_bench.py [n_items] [depth]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_compilation_cache_dir", ".jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+
+sys.path.insert(0, ".")
+
+from mochi_tpu.crypto import batch_verify, keys  # noqa: E402
+from mochi_tpu.verifier.spi import VerifyItem  # noqa: E402
+
+
+def main() -> None:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 65536
+    depth = int(sys.argv[2]) if len(sys.argv) > 2 else 4
+    mb = batch_verify.MAX_BUCKET
+    dev = jax.devices()[0]
+    print(f"device: {dev.platform}, n={n}, MAX_BUCKET={mb}, depth={depth}")
+
+    kp = keys.generate_keypair()
+    t0 = time.perf_counter()
+    items = [
+        VerifyItem(kp.public_key, b"e2e %d" % i, kp.sign(b"e2e %d" % i))
+        for i in range(n)
+    ]
+    print(f"signing {n} items: {time.perf_counter()-t0:.1f}s")
+
+    # Phase timings on one chunk (names the residual if the gap persists)
+    chunk = items[:mb]
+    t0 = time.perf_counter()
+    prepared = batch_verify._prepare_padded(chunk, None)
+    prep_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    launched = batch_verify._dispatch(prepared)
+    dispatch_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    batch_verify._readback(launched, mb)  # includes compile on first call
+    first_readback_s = time.perf_counter() - t0
+
+    # Pipelined ceiling: same prepared buffers, depth batches in flight.
+    rates = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        outs = [batch_verify._dispatch(prepared) for _ in range(depth)]
+        for o in outs:
+            batch_verify._readback(o, mb)
+        rates.append(depth * mb / (time.perf_counter() - t0))
+    pipelined = max(rates)
+
+    # End-to-end: the real verify_batch path (prepare thread + bounded
+    # launch window).  Two runs; report the best (first may still warm).
+    e2e_rates = []
+    for _ in range(2):
+        t0 = time.perf_counter()
+        out = batch_verify.verify_batch(items)
+        e2e_rates.append(n / (time.perf_counter() - t0))
+        assert all(out)
+    e2e = max(e2e_rates)
+
+    rec = {
+        "metric": "e2e_vs_pipelined",
+        "platform": dev.platform,
+        "n_items": n,
+        "max_bucket": mb,
+        "depth": depth,
+        "pipelined_sigs_per_sec": round(pipelined, 1),
+        "e2e_sigs_per_sec": round(e2e, 1),
+        "e2e_fraction_of_pipelined": round(e2e / pipelined, 3),
+        "phase_per_chunk_ms": {
+            "prepare": round(prep_s * 1e3, 1),
+            "dispatch": round(dispatch_s * 1e3, 1),
+            "first_readback_incl_compile": round(first_readback_s * 1e3, 1),
+        },
+        "goal": ">=0.90 of pipelined (VERDICT r3 item 4)",
+    }
+    print("E2E_JSON " + json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,146 @@
+"""Flash capture: the smallest possible committed TPU headline measurement.
+
+VERDICT r3 item 1: three rounds of BENCH have never witnessed the TPU
+headline because the tunnel wedges for hours and dies without warning.
+This script is the battery's FIRST action after the liveness probe — it
+measures exactly the proven headline config (batch 8192, per-coord select,
+pad-skew multiply — the round-2 capture-D recipe) and writes
+``benchmarks/results_r{N}_tpu.json`` with a BENCH-compatible ``headline``
+block, so a 2-minute live window still leaves a committed artifact even if
+the tunnel dies before bench.py's full sweep completes.
+
+Ordering inside the flash itself is also cheapest-first:
+  1. compile the 8192 bucket (populates .jax_cache for every later step)
+  2. sequential best-of-5 with per-batch np.asarray readback
+  3. pipelined depth 4/8 steady state (the honest loaded-verifier rate)
+  4. single-thread OpenSSL baseline for vs_baseline
+
+Usage: python scripts/tpu_flash.py <round-suffix>
+Prints one line ``FLASH_JSON {...}`` and writes/merges the results file.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def merge_round_results(round_n: str, key: str, rec: dict) -> str:
+    """Merge one capture into ``benchmarks/results_r{N}_tpu.json`` atomically.
+
+    The ``headline`` slot keeps the round's best live number: later, richer
+    captures overwrite it only if they beat the incumbent.  tmp+rename so a
+    kill mid-write (this environment's normal failure mode) can't truncate
+    the round's evidence file.  Shared by the flash capture and the
+    battery's bench.py merge step.
+    """
+    out_path = os.path.join(_REPO, "benchmarks", f"results_r{round_n}_tpu.json")
+    doc = {}
+    if os.path.exists(out_path):
+        try:
+            with open(out_path) as fh:
+                doc = json.load(fh)
+        except Exception:
+            doc = {}
+    doc[key] = rec
+    if (
+        rec.get("platform") == "tpu"
+        and rec.get("value", 0) > doc.get("headline", {}).get("value", 0)
+    ):
+        doc["headline"] = rec
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(doc, fh, indent=1)
+    os.replace(tmp, out_path)
+    return out_path
+
+
+def main() -> None:
+    round_n = sys.argv[1] if len(sys.argv) > 1 else "04"
+
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", os.path.join(_REPO, ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+
+    import numpy as np
+
+    from mochi_tpu.crypto import batch_verify, keys
+    from mochi_tpu.crypto.curve import verify_prepared
+    from mochi_tpu.verifier.spi import VerifyItem
+
+    dev = jax.devices()[0]
+    assert dev.platform == "tpu", f"flash capture needs the chip, got {dev.platform}"
+
+    batch = 8192  # round-2 capture-D peak (results_r02_tpu.json)
+    kp = keys.generate_keypair()
+    items = [
+        VerifyItem(kp.public_key, b"flash %d" % i, kp.sign(b"flash %d" % i))
+        for i in range(batch)
+    ]
+    y_a, sign_a, y_r, sign_r, s_bits, h_bits, pre_ok = batch_verify.prepare(items)
+    assert pre_ok.all()
+    args = tuple(
+        jax.device_put(a, dev) for a in (y_a, sign_a, y_r, sign_r, s_bits, h_bits)
+    )
+
+    fn = jax.jit(verify_prepared)
+    t0 = time.perf_counter()
+    out = jax.block_until_ready(fn(*args))
+    compile_s = time.perf_counter() - t0
+    assert np.asarray(out).all()
+
+    # Sequential: every batch pays the full dispatch+tunnel round trip.
+    seq_times = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        np.asarray(fn(*args))  # D2H readback = only trustworthy sync on axon
+        seq_times.append(time.perf_counter() - t0)
+    seq_rate = batch / min(seq_times)
+
+    # Pipelined: several batches in flight, per-batch readback (the loaded
+    # BatchingVerifier posture; round-2 methodology).
+    pipeline = {}
+    for depth in (4, 8):
+        rates = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            outs = [fn(*args) for _ in range(depth)]
+            for o in outs:
+                np.asarray(o)
+            rates.append(depth * batch / (time.perf_counter() - t0))
+        pipeline[depth] = round(max(rates), 1)
+    best_rate = max(seq_rate, max(pipeline.values()))
+
+    sample = items[:256]
+    t0 = time.perf_counter()
+    for it in sample:
+        assert keys.verify(it.public_key, it.message, it.signature)
+    cpu_rate = len(sample) / (time.perf_counter() - t0)
+
+    headline = {
+        "metric": "ed25519_batch_verify_throughput",
+        "value": round(best_rate, 1),
+        "unit": "sigs/sec",
+        "vs_baseline": round(best_rate / cpu_rate, 3),
+        "platform": "tpu",
+        "impl": "xla",
+        "best_batch": batch,
+        "sequential_sigs_per_sec": round(seq_rate, 1),
+        "pipelined_sigs_per_sec_by_depth": pipeline,
+        "compile_s": round(compile_s, 1),
+        "cpu_openssl_sigs_per_sec": round(cpu_rate, 1),
+        "capture": "flash",
+        "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+
+    merge_round_results(round_n, "flash", headline)
+    print("FLASH_JSON " + json.dumps(headline), flush=True)
+
+
+if __name__ == "__main__":
+    main()

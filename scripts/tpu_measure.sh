@@ -2,25 +2,53 @@
 # One-shot TPU measurement battery for mochi-tpu.
 #
 # Chip time is scarce (the round-2 tunnel died mid-session after one
-# capture); this script grabs EVERYTHING in one sitting, cheapest-first,
-# so a partial run still leaves artifacts:
+# capture; the round-3 tunnel was dead for the whole round); this script
+# grabs EVERYTHING in one sitting, cheapest-first, and COMMITS after each
+# milestone so a partial run still leaves committed artifacts:
 #
 #   NOTE round-2 lesson: time device work ONLY with np.asarray readback in
 #   the timed region — the relay's block_until_ready can return before
 #   execution completes (verify_batch/bench.py already comply).
-#   1. liveness probe (watchdogged, throwaway subprocess)
-#   2. headline bench.py  -> BENCH-style JSON (+ per-batch table, MFU)
-#   3. MAX_BUCKET sweep   -> is 8192 the new peak post-signed-windows?
-#   4. run_all --publish  -> benchmarks/results_r<N>.json + BASELINE.json
-#   5. config1 with the shared TPU verifier service
+#   1.  liveness probe (watchdogged, throwaway subprocess)
+#   1b. FLASH capture (VERDICT r3 #1): headline config only, committed
+#       within ~2 min of a live window even if the tunnel dies right after
+#   2.  headline bench.py  -> BENCH-style JSON (+ per-batch table, MFU)
+#   3.  MAX_BUCKET sweep   -> is 8192 still the peak post-packing?
+#   3b. kernel-formulation A/B ladder (select impl, MXU skew — r3 levers)
+#   3c. roofline cycle decomposition
+#   3d. end-to-end vs pipelined 64k (VERDICT r3 #4)
+#   3e. forged-fraction sweep (VERDICT r3 #8)
+#   4.  run_all --publish  -> benchmarks/results_r<N>.json + BASELINE.json
+#       (config 5 now measures the packed production path — VERDICT r3 #3)
+#   5.  config1 with the shared TPU verifier service
+#   6.  bounded Pallas retry, time-boxed (VERDICT r3 #9) — LAST: it can
+#       eat 15+ min of chip time for a known-likely negative result
 #
-# Usage: scripts/tpu_measure.sh [round-suffix]   (default: next free)
+# Usage: scripts/tpu_measure.sh [round-suffix]
 set -uo pipefail
 REPO_DIR=$(cd "$(dirname "$0")/.." && pwd)
 cd "$REPO_DIR"
 export PYTHONPATH="${REPO_DIR}${PYTHONPATH:+:$PYTHONPATH}"
-ROUND=${1:-03}
+ROUND=${1:-04}
 OUT="benchmarks/tpu_measure_r${ROUND}.log"
+
+# Failure accounting: set -e would abort the whole battery on one flaky
+# step, but exiting 0 after a mid-run tunnel death would tell the watchdog
+# the battery finished and stop its probe loop (round-3 review finding).
+# Each step reports into FAILED; the battery exits non-zero if any step
+# failed so the watchdog keeps watching for another live window.
+FAILED=0
+step_rc() {  # step_rc <name> <rc>
+  if [ "$2" -ne 0 ]; then
+    FAILED=$((FAILED + 1))
+    echo "[step $1 FAILED rc=$2]" | tee -a "$OUT"
+  fi
+}
+
+commit_artifacts() {
+  git add benchmarks/ BASELINE.json 2>/dev/null
+  git commit -q -m "$1" -- benchmarks/ BASELINE.json 2>>"$OUT" || true
+}
 
 echo "== 1. liveness" | tee "$OUT"
 if ! timeout 120 python -c "import jax; d=jax.devices()[0]; assert d.platform=='tpu'; print('chip:', d)" >>"$OUT" 2>&1; then
@@ -28,8 +56,30 @@ if ! timeout 120 python -c "import jax; d=jax.devices()[0]; assert d.platform=='
   exit 1
 fi
 
+echo "== 1b. flash capture (headline config, committed immediately)" | tee -a "$OUT"
+timeout 420 python scripts/tpu_flash.py "$ROUND" 2>&1 | tee -a "$OUT"
+step_rc flash "${PIPESTATUS[0]}"
+commit_artifacts "TPU flash capture r${ROUND}: live headline measurement"
+
 echo "== 2. headline bench" | tee -a "$OUT"
-timeout 2400 python bench.py | tee -a "$OUT"
+MOCHI_BENCH_ROUND="$ROUND" timeout 2400 python bench.py 2>&1 | tee -a "$OUT"
+step_rc bench "${PIPESTATUS[0]}"
+# Merge bench.py's full JSON into the round's results file (it is richer
+# than the flash: per-batch table, MFU, CPU fleet baseline).
+python - "$ROUND" <<'EOF' 2>&1 | tee -a "$OUT"
+import json, sys
+sys.path.insert(0, "scripts")
+from tpu_flash import merge_round_results
+round_n = sys.argv[1]
+log = open(f"benchmarks/tpu_measure_r{round_n}.log").read()
+hits = [l for l in log.splitlines() if l.startswith('{"metric"')]
+if hits:
+    rec = json.loads(hits[-1])
+    print("merged bench.py record into",
+          merge_round_results(round_n, "bench", rec))
+EOF
+step_rc bench_merge "${PIPESTATUS[0]}"
+commit_artifacts "TPU measurement battery r${ROUND}: headline bench"
 
 echo "== 3. MAX_BUCKET sweep (8192 was the round-2 peak; check 16384 post-packing)" | tee -a "$OUT"
 for mb in 8192 16384; do
@@ -48,6 +98,7 @@ dt = time.perf_counter() - t0
 assert all(out)
 print(f"MAX_BUCKET={mb}: {mb/dt:.1f} sigs/s ({dt*1e3:.1f} ms)")
 EOF
+  step_rc "bucket$mb" "${PIPESTATUS[0]}"
 done
 
 echo "== 3b. kernel-formulation A/B (select impl; MXU column-reduction multiply)" | tee -a "$OUT"
@@ -73,13 +124,26 @@ for _ in range(3):
 assert all(out)
 print(f"{os.environ['MOCHI_AB_LEG']}: best {best:.1f} sigs/s at batch {n}")
 EOF
+  step_rc "ab:$leg" "${PIPESTATUS[0]}"
 done
 
 echo "== 3c. cycle decomposition (roofline evidence for the MFU story)" | tee -a "$OUT"
 timeout 1200 python scripts/roofline.py 8192 2>&1 | tee -a "$OUT"
+step_rc roofline "${PIPESTATUS[0]}"
+
+echo "== 3d. end-to-end vs pipelined on 64k items (goal >=90%)" | tee -a "$OUT"
+timeout 1200 python scripts/e2e_bench.py 65536 2>&1 | tee -a "$OUT"
+step_rc e2e "${PIPESTATUS[0]}"
+
+echo "== 3e. forged-fraction throughput sweep (no-cliff proof)" | tee -a "$OUT"
+timeout 900 python scripts/forgery_bench.py 8192 2>&1 | tee -a "$OUT"
+step_rc forgery "${PIPESTATUS[0]}"
+commit_artifacts "TPU battery r${ROUND}: sweeps, A/B ladder, roofline, e2e, forgery"
 
 echo "== 4. publish all configs" | tee -a "$OUT"
 MOCHI_BENCH_ROUND="$ROUND" timeout 5400 python -m benchmarks.run_all --publish 2>&1 | tee -a "$OUT"
+step_rc publish "${PIPESTATUS[0]}"
+commit_artifacts "TPU battery r${ROUND}: run_all publish"
 
 echo "== 5. config1 via shared TPU verifier service" | tee -a "$OUT"
 timeout 1200 python -c "
@@ -88,5 +152,15 @@ jax.config.update('jax_compilation_cache_dir', '.jax_cache')
 from benchmarks import config1_cluster
 print(json.dumps(config1_cluster.run(5, 40, 2, verifier='service')))
 " 2>&1 | tee -a "$OUT"
+step_rc config1_service "${PIPESTATUS[0]}"
 
-echo "DONE — commit benchmarks/results_r${ROUND}.json, BASELINE.json and $OUT" | tee -a "$OUT"
+echo "== 6. bounded Pallas retry (time-boxed; VERDICT r3 #9)" | tee -a "$OUT"
+# 1800s outer budget: two 600s legs + jax init + 3 timed runs per
+# successful leg must fit with margin, else the parent is SIGTERMed and
+# the DID-NOT-FINISH record is lost.
+timeout 1800 python scripts/pallas_retry.py 600 2>&1 | tee -a "$OUT"
+step_rc pallas_retry "${PIPESTATUS[0]}"
+commit_artifacts "TPU battery r${ROUND}: config1 service + pallas retry"
+
+echo "DONE (failed_steps=$FAILED) — artifacts committed per-milestone; see benchmarks/results_r${ROUND}_tpu.json and $OUT" | tee -a "$OUT"
+[ "$FAILED" -eq 0 ]

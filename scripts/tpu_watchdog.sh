@@ -10,18 +10,21 @@
 set -uo pipefail
 REPO_DIR=$(cd "$(dirname "$0")/.." && pwd)
 cd "$REPO_DIR"
-ROUND=${1:-03}
+ROUND=${1:-04}
 LOG="benchmarks/tpu_watchdog_r${ROUND}.log"
-PIDFILE="/tmp/mochi_tpu_watchdog_r${ROUND}.pid"
+LOCKFILE="/tmp/mochi_tpu_watchdog_r${ROUND}.lock"
 
 # Single-instance guard: two watchdogs would fire concurrent batteries on
-# the scarce chip and race the capture commit.
-if [ -f "$PIDFILE" ] && kill -0 "$(cat "$PIDFILE")" 2>/dev/null; then
-  echo "[watchdog] already running (pid $(cat "$PIDFILE")); exiting" | tee -a "$LOG"
+# the scarce chip and race the capture commit.  flock (not a pidfile): the
+# round-3 pidfile check-then-write admitted two overlapping loops when
+# both raced past the kill -0 before either wrote the file (VERDICT r3
+# weak #6).  The fd is held for the process lifetime; the kernel releases
+# it atomically on ANY exit, so there is no stale-lock cleanup either.
+exec 9>"$LOCKFILE"
+if ! flock -n 9; then
+  echo "[watchdog] already running (lock $LOCKFILE held); exiting" | tee -a "$LOG"
   exit 0
 fi
-echo $$ >"$PIDFILE"
-trap 'rm -f "$PIDFILE"' EXIT
 
 probe() {
   timeout 150 python -u - <<'EOF' >/dev/null 2>&1
@@ -36,18 +39,32 @@ EOF
 
 echo "[watchdog] start $(date -u +%FT%TZ)" | tee -a "$LOG"
 n=0
+batteries=0
+MAX_BATTERIES=3  # retry cap: a deterministic battery bug must not burn the
+                 # whole live window re-running a multi-hour battery forever
 while true; do
   n=$((n + 1))
   if probe; then
-    echo "[watchdog] probe $n LIVE $(date -u +%FT%TZ) — firing battery" | tee -a "$LOG"
-    bash scripts/tpu_measure.sh "$ROUND" 2>&1 | tail -40 >>"$LOG"
-    echo "[watchdog] battery done $(date -u +%FT%TZ) rc=$?" | tee -a "$LOG"
-    # Chip time is scarce and the tunnel dies without warning: commit the
-    # captures the moment they exist.
+    batteries=$((batteries + 1))
+    echo "[watchdog] probe $n LIVE $(date -u +%FT%TZ) — firing battery $batteries/$MAX_BATTERIES" | tee -a "$LOG"
+    bash scripts/tpu_measure.sh "$ROUND" 2>&1 | tail -60 >>"$LOG"
+    rc=${PIPESTATUS[0]}  # the battery's status, not tail's (ADVICE r3)
+    echo "[watchdog] battery done $(date -u +%FT%TZ) rc=$rc" | tee -a "$LOG"
+    # The battery commits per-milestone; this is the belt-and-braces final
+    # commit in case it died between a milestone and its commit.
     git add benchmarks/ BASELINE.json 2>/dev/null
     git commit -q -m "TPU measurement battery r${ROUND}: live captures" \
       -- benchmarks/ BASELINE.json 2>>"$LOG" || true
-    exit 0
+    if [ "$rc" -ne 0 ] && [ "$batteries" -lt "$MAX_BATTERIES" ]; then
+      # Battery aborted (tunnel died mid-run?) — keep watching; a later
+      # window can still finish the remaining steps (per-milestone commits
+      # make re-runs cheap, and the compile cache is warm).
+      echo "[watchdog] battery rc=$rc — resuming probe loop" | tee -a "$LOG"
+      sleep 170
+      continue
+    fi
+    [ "$rc" -ne 0 ] && echo "[watchdog] battery retry cap reached; exiting" | tee -a "$LOG"
+    exit "$rc"
   fi
   echo "[watchdog] probe $n dead $(date -u +%FT%TZ)" >>"$LOG"
   sleep 170

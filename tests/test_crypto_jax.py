@@ -156,6 +156,38 @@ class TestBatchVerify:
     def test_empty_batch(self):
         assert BV.verify_batch([]) == []
 
+    def test_all_rejected_batch_skips_device(self, monkeypatch):
+        """A chunk whose prechecks reject every item (garbage flood) must
+        return all-False WITHOUT launching the device program — the
+        no-device-amplification property scripts/forgery_bench.py measures."""
+        kp = generate_keypair()
+        # S >= L: canonical-length but fails the host range precheck
+        garbage = [
+            VerifyItem(kp.public_key, b"g%d" % i, kp.sign(b"g%d" % i)[:32] + b"\xff" * 32)
+            for i in range(8)
+        ]
+        calls = []
+        orig = BV._verify_packed_jit
+        monkeypatch.setattr(
+            BV, "_verify_packed_jit",
+            lambda *a, **k: calls.append(1) or orig(*a, **k),
+        )
+        assert BV.verify_batch(garbage) == [False] * 8
+        assert not calls, "device program ran on an all-rejected batch"
+        # Mixed batch still goes to the device and keeps per-item verdicts
+        ok_msg = b"ok"
+        mixed = garbage + [VerifyItem(kp.public_key, ok_msg, kp.sign(ok_msg))]
+        assert BV.verify_batch(mixed) == [False] * 8 + [True]
+        assert calls
+        # The skip must NOT mark the bucket compiled in the backend: the
+        # next legitimate batch would then park behind a synchronous
+        # 20-60 s compile (review finding, round 4).
+        backend = BV.JaxBatchBackend(min_device_items=0)
+        assert backend(garbage) == [False] * 8
+        assert BV._bucket_size(8) not in backend._ready
+        assert list(backend(mixed)) == [False] * 8 + [True]
+        assert BV._bucket_size(9) in backend._ready
+
     def test_backend_plugs_into_spi(self):
         backend = BV.JaxBatchBackend(min_device_items=0)  # pin the device path: this test checks bucket behavior
         kp = generate_keypair()
