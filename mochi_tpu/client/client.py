@@ -71,6 +71,12 @@ SEED_RANGE = 1000  # ref: MochiDBClient.java:262 — seed = rand.nextInt(1000)
 # trying that replica again (see MochiDBClient._session_refused).
 SESSION_REFUSAL_TTL_S = 30.0
 
+# Consecutive fully-shed Write1 rounds before the client stops retrying and
+# surfaces hard overload as a typed RequestRefused.  At moderate shed
+# probabilities a spurious give-up is <1% (draws are per-attempt), while
+# hard overload (p~0.9) still fails in ~1 s of backoff.
+MAX_ALL_SHED_ROUNDS = 5
+
 
 @dataclass
 class MochiDBClient:
@@ -696,20 +702,16 @@ class MochiDBClient:
                         # control, not refusal: exponential jittered backoff
                         # (the explicit retry-with-backoff contract of
                         # FailType.OVERLOADED), and it doesn't burn the
-                        # refusal budget.  Three consecutive fully-shed
-                        # rounds mean hard overload: surface it as a typed
-                        # failure in bounded time instead of hammering an
-                        # already-saturated cluster with retries (every
-                        # retry is 2(rf) more messages the cluster must
-                        # shed again).
+                        # refusal budget.  MAX_ALL_SHED_ROUNDS consecutive
+                        # fully-shed rounds mean hard overload: surface it
+                        # as a typed failure in bounded time instead of
+                        # hammering an already-saturated cluster with
+                        # retries (every retry is 2(rf) more messages the
+                        # cluster must shed again).
                         self.metrics.mark("client.write1-shed")
                         if shed >= len(responses) and len(responses) > 0:
                             all_shed_rounds += 1
-                            # 5 consecutive fully-shed rounds: at moderate
-                            # shed probabilities a spurious give-up is then
-                            # <1% (draws are per-attempt), while hard
-                            # overload (p~0.9) still fails in ~1s of backoff
-                            if all_shed_rounds >= 5:
+                            if all_shed_rounds >= MAX_ALL_SHED_ROUNDS:
                                 raise RequestRefused(
                                     "cluster overloaded: write shed by "
                                     f"admission control {all_shed_rounds}x"

@@ -267,6 +267,14 @@ static int rd_varint(Rd *r, unsigned long long *out) {
                 PyErr_SetString(PyExc_ValueError, "mcode: varint out of 64-bit range");
                 return -1;
             }
+            /* canonical-only: a multi-byte varint ending in 0x00 carries no
+             * bits in its last byte => non-minimal encoding.  The encoder
+             * only emits minimal forms; accepting others would let two
+             * distinct frames decode identically (signed-slice attacks). */
+            if (shift > 0 && byte == 0) {
+                PyErr_SetString(PyExc_ValueError, "mcode: non-canonical varint");
+                return -1;
+            }
             *out = result;
             return 0;
         }

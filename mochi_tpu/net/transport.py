@@ -355,7 +355,7 @@ class RpcClientPool:
         self, info: ServerInfo, env: Envelope, timeout_s: Optional[float] = None
     ) -> Envelope:
         return await self._conn(info).send_and_receive(
-            env, timeout_s or self.default_timeout_s
+            env, self.default_timeout_s if timeout_s is None else timeout_s
         )
 
     async def close(self) -> None:
@@ -384,7 +384,9 @@ async def fan_out(
     authenticate per target (session MACs).
     """
     targets = list(targets)
-    timeout = timeout_s or pool.default_timeout_s
+    # `is None` (not falsy-or): an explicit timeout_s=0 means "no waiting",
+    # not "use the default" (ADVICE r3).
+    timeout = pool.default_timeout_s if timeout_s is None else timeout_s
     out: Dict[str, Envelope | Exception] = {}
 
     # Steady state: every target connection is open, so each request is a
@@ -415,24 +417,43 @@ async def fan_out(
     async def one(sid: str, info: ServerInfo) -> Envelope:
         return await pool.send_and_receive(info, make_envelope(new_msg_id(), sid), timeout)
 
-    slow_results = (
-        await asyncio.gather(
-            *(one(sid, info) for sid, info in slow), return_exceptions=True
+    # Slow path (unconnected targets: dial + handshake + request, each leg
+    # bounded by `timeout` inside send_and_receive) runs CONCURRENTLY with
+    # the fast-path wait below — serially, one down replica would stretch
+    # the whole fan-out to ~2x the budget (ADVICE r3).
+    slow_task = (
+        asyncio.ensure_future(
+            asyncio.gather(
+                *(one(sid, info) for sid, info in slow), return_exceptions=True
+            )
         )
         if slow
-        else []
+        else None
     )
-    for (sid, _), res in zip(slow, slow_results):
-        out[sid] = res
 
-    if waiting:
-        await asyncio.wait([f for _, f, _, _ in waiting], timeout=timeout)
-        for sid, fut, msg_id, conn in waiting:
-            conn.pending.pop(msg_id, None)
-            if fut.done():
-                exc = fut.exception()
-                out[sid] = exc if exc is not None else fut.result()
-            else:
-                fut.cancel()
-                out[sid] = TimeoutError(f"no response from {sid} in {timeout}s")
-    return out
+    try:
+        if waiting:
+            await asyncio.wait([f for _, f, _, _ in waiting], timeout=timeout)
+            for sid, fut, msg_id, conn in waiting:
+                conn.pending.pop(msg_id, None)
+                if fut.done():
+                    exc = fut.exception()
+                    out[sid] = exc if exc is not None else fut.result()
+                else:
+                    fut.cancel()
+                    out[sid] = TimeoutError(f"no response from {sid} in {timeout}s")
+
+        if slow_task is not None:
+            # Already ran alongside the fast-path wait; each leg is
+            # internally deadline-bounded, so this completes ~immediately
+            # after it.
+            slow_results = await slow_task
+            for (sid, _), res in zip(slow, slow_results):
+                out[sid] = res
+        return out
+    finally:
+        # Structured concurrency: if the fan-out itself is cancelled (caller
+        # deadline, shutdown) the detached slow-path task must not keep
+        # dialing replicas and sending envelopes in the background.
+        if slow_task is not None and not slow_task.done():
+            slow_task.cancel()

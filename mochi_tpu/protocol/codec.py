@@ -117,6 +117,13 @@ class _Reader:
             if not (b & 0x80):
                 if result >= 1 << 64:
                     raise ValueError("mcode: varint out of 64-bit range")
+                # Canonical-only: a multi-byte varint ending in 0x00 carries
+                # no bits in its last byte => non-minimal.  The encoder only
+                # emits minimal forms; accepting others would let two
+                # distinct frames decode to the same value (and shift the
+                # envelope's signed-prefix slice — ADVICE r3).
+                if shift > 0 and b == 0:
+                    raise ValueError("mcode: non-canonical varint")
                 return result
             shift += 7
             if shift > 63:

@@ -663,6 +663,14 @@ def encode_envelope(env: Envelope) -> bytes:
 
 
 def decode_envelope(data: bytes) -> Envelope:
+    # Canonical-header check (ADVICE r3): the signed-prefix reconstruction
+    # below assumes the outer varint(8) is the single byte 0x08.  The codec
+    # readers now reject non-minimal varints everywhere, but a STALE
+    # prebuilt native .so (bound via the getattr guard in codec._bind)
+    # could predate that check — this belt-and-braces guard keeps the
+    # _six_bytes slice sound regardless of which codec decoded the frame.
+    if len(data) < 2 or data[1] != 0x08:
+        raise ValueError("mcode: envelope header must be canonical T_LIST(8)")
     (tag, payload_obj, msg_id, sender_id, reply_to, ts, sig, mac), off6 = decode_env(data)
     if not 0 <= tag < len(_PAYLOAD_TYPES):
         raise ValueError(f"unknown payload tag {tag}")

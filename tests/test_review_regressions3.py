@@ -1,0 +1,112 @@
+"""Regressions for the round-3 advisor findings (ADVICE.md r3).
+
+1. ``fan_out`` runs its slow path (unconnected targets) concurrently with
+   the fast-path wait — one down replica must not stretch a fan-out to
+   ~2x the timeout budget.
+2. An explicit ``timeout_s=0`` means "no waiting", not "use the default".
+3. ``decode_envelope`` rejects a non-canonical multi-byte varint header
+   (would silently shift the signed-prefix slice and surface as a
+   confusing BAD_SIGNATURE instead of a decode error).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+import pytest
+
+from mochi_tpu.cluster.config import ServerInfo
+from mochi_tpu.net.transport import RpcClientPool, fan_out
+from mochi_tpu.protocol import Envelope, HelloToServer, decode_envelope, encode_envelope
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, timeout=30))
+
+
+async def _black_hole_server():
+    """Accepts connections and reads frames but never responds."""
+
+    async def handle(reader, writer):
+        try:
+            while await reader.read(65536):
+                pass
+        except Exception:
+            pass
+
+    server = await asyncio.start_server(handle, "127.0.0.1", 0)
+    port = server.sockets[0].getsockname()[1]
+    return server, port
+
+
+def _env(msg_id: str) -> Envelope:
+    return Envelope(HelloToServer("probe"), msg_id, "test-client")
+
+
+def test_fan_out_slow_path_shares_the_timeout_budget():
+    """One never-connected target + one connected-but-silent target: the
+    fan-out must finish in ~1x the timeout, not slow-then-fast serial 2x."""
+
+    async def main():
+        server, port = await _black_hole_server()
+        fast_info = ServerInfo("fast", "127.0.0.1", port)
+        # Dead port: connect fails fast, but ensure_connected retries
+        # 3x100ms inside the slow path — still well under one timeout.
+        server2, port2 = await _black_hole_server()
+        slow_info = ServerInfo("slow", "127.0.0.1", port2)
+
+        pool = RpcClientPool(default_timeout_s=1.0)
+        # Pre-connect the fast target so it takes the fast path.
+        await pool._conn(fast_info).ensure_connected()
+        assert pool._conn(fast_info).connected
+        assert not pool._conn(slow_info).connected
+
+        t0 = time.perf_counter()
+        out = await fan_out(
+            pool,
+            [("fast", fast_info), ("slow", slow_info)],
+            lambda msg_id, sid: _env(msg_id),
+            timeout_s=1.0,
+        )
+        elapsed = time.perf_counter() - t0
+        assert isinstance(out["fast"], Exception)
+        assert isinstance(out["slow"], Exception)
+        # Serial slow-then-fast would be ~2.0s; concurrent is ~1.0s.
+        assert elapsed < 1.7, f"fan_out took {elapsed:.2f}s — slow path serialized"
+        await pool.close()
+        server.close()
+        server2.close()
+
+    run(main())
+
+
+def test_fan_out_explicit_zero_timeout_is_not_the_default():
+    async def main():
+        server, port = await _black_hole_server()
+        info = ServerInfo("s", "127.0.0.1", port)
+        pool = RpcClientPool(default_timeout_s=30.0)
+        await pool._conn(info).ensure_connected()
+        t0 = time.perf_counter()
+        out = await fan_out(
+            pool, [("s", info)], lambda msg_id, sid: _env(msg_id), timeout_s=0
+        )
+        elapsed = time.perf_counter() - t0
+        assert isinstance(out["s"], Exception)
+        assert elapsed < 5.0, "timeout_s=0 fell back to the 30s default"
+        await pool.close()
+        server.close()
+
+    run(main())
+
+
+def test_decode_envelope_rejects_noncanonical_header():
+    env = Envelope(HelloToServer("hi"), "m1", "s1")
+    wire = encode_envelope(env)
+    assert wire[:2] == b"\x07\x08"
+    assert decode_envelope(wire).msg_id == "m1"
+    # Same frame with varint(8) spelled as the two-byte form 88 00: must be
+    # a loud decode error, not a shifted signed-prefix slice.
+    bad = wire[:1] + b"\x88\x00" + wire[2:]
+    with pytest.raises(ValueError):
+        decode_envelope(bad)
