@@ -37,6 +37,7 @@ async def _run(
     factory = None
     if verifier == "service":
         from mochi_tpu.verifier.service import RemoteVerifier, VerifierService
+        from mochi_tpu.verifier.spi import CoalescingVerifier
         from mochi_tpu.verifier.spi import CpuVerifier
 
         inner = None
@@ -55,7 +56,7 @@ async def _run(
         service = VerifierService(port=0, verifier=inner)
         await service.start()
         port = service.bound_port
-        factory = lambda: RemoteVerifier("127.0.0.1", port)
+        factory = lambda: CoalescingVerifier(RemoteVerifier("127.0.0.1", port))
 
     try:
         return await _run_cluster(

@@ -141,7 +141,7 @@ def run_cluster_ycsb(
     from mochi_tpu.client.txn import TransactionBuilder
     from mochi_tpu.testing.virtual_cluster import VirtualCluster
     from mochi_tpu.verifier.service import RemoteVerifier, VerifierService
-    from mochi_tpu.verifier.spi import CpuVerifier
+    from mochi_tpu.verifier.spi import CoalescingVerifier, CpuVerifier
 
     rng = np.random.default_rng(4242)
 
@@ -162,7 +162,7 @@ def run_cluster_ycsb(
             inner = CpuVerifier()
         service = VerifierService(port=0, verifier=inner)
         await service.start()
-        factory = lambda: RemoteVerifier("127.0.0.1", service.bound_port)
+        factory = lambda: CoalescingVerifier(RemoteVerifier("127.0.0.1", service.bound_port))
         try:
             return await _ycsb_cluster(factory, platform, service)
         finally:

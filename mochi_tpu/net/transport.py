@@ -415,7 +415,14 @@ async def fan_out(
         waiting.append((sid, fut, env.msg_id, conn))
 
     async def one(sid: str, info: ServerInfo) -> Envelope:
-        return await pool.send_and_receive(info, make_envelope(new_msg_id(), sid), timeout)
+        # wait_for bounds the WHOLE leg including the TCP connect inside
+        # ensure_connected — a black-holed host (dropped SYNs) otherwise
+        # holds create_connection for the kernel's ~2 min connect timeout,
+        # far past this fan-out's budget.
+        return await asyncio.wait_for(
+            pool.send_and_receive(info, make_envelope(new_msg_id(), sid), timeout),
+            timeout=timeout,
+        )
 
     # Slow path (unconnected targets: dial + handshake + request, each leg
     # bounded by `timeout` inside send_and_receive) runs CONCURRENTLY with

@@ -69,7 +69,12 @@ async def amain(args) -> None:
             from ..verifier.service import load_secret
 
             secret = load_secret(args.verifier_secret_file)
-        verifier = RemoteVerifier(host, int(port), secret=secret)
+        from ..verifier.spi import CoalescingVerifier
+
+        # Coalescer: concurrent Write2 certificate checks share one RPC
+        # round trip to the service instead of paying one each (two
+        # loopback frames per call dominate the replica-side cost).
+        verifier = CoalescingVerifier(RemoteVerifier(host, int(port), secret=secret))
     elif args.verifier != "cpu":
         # No silent fallback: a typo'd --verifier must not quietly run the
         # inline CPU path (the misconfiguration argparse choices= used to

@@ -12,8 +12,7 @@ from __future__ import annotations
 import math
 import time
 from collections import defaultdict, deque
-from contextlib import contextmanager
-from typing import Dict, Iterator, List
+from typing import Dict
 
 
 class Timer:
@@ -62,6 +61,29 @@ class Timer:
         }
 
 
+class _TimerCtx:
+    """Hand-rolled timing context.
+
+    This runs ~40x per transaction across client + replicas; the
+    ``@contextmanager`` generator formulation costs a generator frame, two
+    ``next()`` dispatches and a ``contextlib`` helper object per use —
+    measured at ~6% of cluster CPU in the config-1 profile.  A plain
+    two-method object is one attribute store and two perf_counter calls.
+    """
+
+    __slots__ = ("_timer", "_start")
+
+    def __init__(self, timer: Timer) -> None:
+        self._timer = timer
+
+    def __enter__(self) -> "_TimerCtx":
+        self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self._timer.record(time.perf_counter() - self._start)
+
+
 class Metrics:
     """Registry of named timers and counters."""
 
@@ -69,13 +91,8 @@ class Metrics:
         self.timers: Dict[str, Timer] = defaultdict(Timer)
         self.counters: Dict[str, int] = defaultdict(int)
 
-    @contextmanager
-    def timer(self, name: str) -> Iterator[None]:
-        start = time.perf_counter()
-        try:
-            yield
-        finally:
-            self.timers[name].record(time.perf_counter() - start)
+    def timer(self, name: str) -> _TimerCtx:
+        return _TimerCtx(self.timers[name])
 
     def mark(self, name: str, n: int = 1) -> None:
         self.counters[name] += n

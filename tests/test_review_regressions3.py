@@ -110,3 +110,58 @@ def test_decode_envelope_rejects_noncanonical_header():
     bad = wire[:1] + b"\x88\x00" + wire[2:]
     with pytest.raises(ValueError):
         decode_envelope(bad)
+
+
+def test_coalescing_verifier_merges_concurrent_calls():
+    """Concurrent verify_batch calls must share inner round trips (one RPC
+    per round trip, not per certificate) with per-caller correct bitmaps."""
+    from mochi_tpu.verifier.spi import CoalescingVerifier, SignatureVerifier, VerifyItem
+
+    class SlowInner(SignatureVerifier):
+        def __init__(self):
+            self.calls = 0
+
+        async def verify_batch(self, items):
+            self.calls += 1
+            await asyncio.sleep(0.02)  # the "round trip"
+            return [it.message.startswith(b"ok") for it in items]
+
+    async def main():
+        inner = SlowInner()
+        cv = CoalescingVerifier(inner)
+
+        async def one(i):
+            items = [
+                VerifyItem(b"k" * 32, b"ok %d" % i, b"s" * 64),
+                VerifyItem(b"k" * 32, b"bad %d" % i, b"s" * 64),
+            ]
+            return await cv.verify_batch(items)
+
+        results = await asyncio.gather(*(one(i) for i in range(10)))
+        assert all(r == [True, False] for r in results)
+        # call 0 flushes alone; calls 1..9 arrive during its round trip and
+        # must ride ONE combined flush => 2 inner calls, not 10.
+        assert inner.calls <= 3, f"no coalescing: {inner.calls} inner calls"
+        await cv.close()
+
+    run(main())
+
+
+def test_coalescing_verifier_propagates_inner_failure():
+    from mochi_tpu.verifier.spi import CoalescingVerifier, SignatureVerifier, VerifyItem
+
+    class BoomInner(SignatureVerifier):
+        async def verify_batch(self, items):
+            raise RuntimeError("boom")
+
+    async def main():
+        cv = CoalescingVerifier(BoomInner())
+        items = [VerifyItem(b"k" * 32, b"m", b"s" * 64)]
+        try:
+            await cv.verify_batch(items)
+        except RuntimeError as exc:
+            assert "boom" in str(exc)
+        else:
+            raise AssertionError("inner failure swallowed")
+
+    run(main())
