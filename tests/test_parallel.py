@@ -82,3 +82,26 @@ def test_pad_to_multiple_dead_groups(mesh):
     assert bitmap[:n].all() and not bitmap[n:].any()
     assert (counts[:n_groups] == np.bincount(group_ids, minlength=n_groups)).all()
     assert counts[n_groups] == 0
+
+
+def test_sharded_backend_all_rejected_skips_device(mesh):
+    """ShardedJaxBatchBackend: a garbage-flood chunk (every precheck fails)
+    returns all-False without dispatching the mesh program, and without
+    bumping the dispatch counter that gates bucket-readiness (mirrors the
+    single-device fast path; round-4 review finding)."""
+    from mochi_tpu.crypto import batch_verify
+    from mochi_tpu.verifier.tpu import ShardedJaxBatchBackend
+
+    backend = ShardedJaxBatchBackend(mesh=mesh, min_device_items=0)
+    good = _signed_items(8)
+    garbage = [
+        VerifyItem(it.public_key, it.message, it.signature[:32] + b"\xff" * 32)
+        for it in good
+    ]
+    before = batch_verify.device_dispatch_count()
+    assert backend._sharded_verify(garbage) == [False] * 8
+    assert batch_verify.device_dispatch_count() == before
+    # mixed batch still runs the mesh program with per-item verdicts
+    out = backend._sharded_verify(good + garbage)
+    assert out == [True] * 8 + [False] * 8
+    assert batch_verify.device_dispatch_count() == before + 1
