@@ -156,6 +156,27 @@ class TestBatchVerify:
     def test_empty_batch(self):
         assert BV.verify_batch([]) == []
 
+    def test_chunked_stream_with_all_garbage_chunk(self, monkeypatch):
+        """Chunked verify_batch: an all-rejected chunk inside the bounded
+        launch window must skip its device launch (None in the pipeline)
+        while neighboring chunks keep their verdicts — the fast path and
+        the prepare-thread pipeline compose."""
+        monkeypatch.setattr(BV, "MAX_BUCKET", 16)
+        kp = generate_keypair()
+        good = [
+            VerifyItem(kp.public_key, b"c%d" % i, kp.sign(b"c%d" % i))
+            for i in range(16)
+        ]
+        garbage = [
+            VerifyItem(it.public_key, it.message, it.signature[:32] + b"\xff" * 32)
+            for it in good
+        ]
+        stream = good + garbage + good  # 3 chunks at MAX_BUCKET=16
+        before = BV.device_dispatch_count()
+        out = BV.verify_batch(stream)
+        assert out == [True] * 16 + [False] * 16 + [True] * 16
+        assert BV.device_dispatch_count() == before + 2  # garbage chunk skipped
+
     def test_all_rejected_batch_skips_device(self, monkeypatch):
         """A chunk whose prechecks reject every item (garbage flood) must
         return all-False WITHOUT launching the device program — the
