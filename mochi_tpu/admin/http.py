@@ -170,6 +170,15 @@ class AdminServer(HttpJsonServer):
             )
         if path == "/metrics":
             return 200, "application/json", json.dumps(r.metrics.snapshot())
+        if path == "/metrics.prom":
+            # Prometheus text exposition for a standard scrape stack (the
+            # reference exposed Dropwizard timers via a JMX reporter,
+            # MochiDBClient.java:52-70; this is the modern equivalent).
+            return (
+                200,
+                "text/plain; version=0.0.4",
+                r.metrics.to_prometheus({"server": r.server_id}),
+            )
         if path == "/" or path == "/index.html":
             cfg = r.config
             member_rows = "".join(

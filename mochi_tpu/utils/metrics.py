@@ -102,3 +102,35 @@ class Metrics:
             "timers": {name: t.snapshot() for name, t in self.timers.items()},
             "counters": dict(self.counters),
         }
+
+    def to_prometheus(self, labels: Dict[str, str]) -> str:
+        """Prometheus text exposition (the Dropwizard/JMX-reporter analog
+        for a modern scrape stack).  Metric identity goes into the ``name``
+        label so arbitrary dotted timer names stay valid."""
+
+        def esc(v: str) -> str:
+            return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+        base = ",".join(f'{k}="{esc(v)}"' for k, v in sorted(labels.items()))
+        lines = [
+            "# TYPE mochi_timer_count counter",
+            "# TYPE mochi_timer_seconds_mean gauge",
+            "# TYPE mochi_timer_seconds_p50 gauge",
+            "# TYPE mochi_timer_seconds_p99 gauge",
+            "# TYPE mochi_counter_total counter",
+        ]
+        for name, t in sorted(self.timers.items()):
+            lab = f'name="{esc(name)}"' + (f",{base}" if base else "")
+            lines.append(f"mochi_timer_count{{{lab}}} {t.count}")
+            if t.count:
+                lines.append(f"mochi_timer_seconds_mean{{{lab}}} {t.mean:.9f}")
+                lines.append(
+                    f"mochi_timer_seconds_p50{{{lab}}} {t.percentile(50):.9f}"
+                )
+                lines.append(
+                    f"mochi_timer_seconds_p99{{{lab}}} {t.percentile(99):.9f}"
+                )
+        for name, n in sorted(self.counters.items()):
+            lab = f'name="{esc(name)}"' + (f",{base}" if base else "")
+            lines.append(f"mochi_counter_total{{{lab}}} {n}")
+        return "\n".join(lines) + "\n"

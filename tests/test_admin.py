@@ -43,6 +43,17 @@ async def _main():
             assert status == 200
             json.loads(body)
 
+            status, ctype, body = await loop.run_in_executor(
+                None, _get, port, "/metrics.prom"
+            )
+            assert status == 200 and "text/plain" in ctype
+            assert "mochi_counter_total{" in body or "mochi_timer_count{" in body
+            assert f'server="{replica.server_id}"' in body
+            # every sample line: name{labels} value
+            for line in body.splitlines():
+                if line and not line.startswith("#"):
+                    assert "} " in line and line.startswith("mochi_"), line
+
             status, _, body = await loop.run_in_executor(None, _get, port, "/json")
             assert status == 200 and json.loads(body)["hello"] == "mochi-tpu"
 
