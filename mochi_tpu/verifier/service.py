@@ -313,6 +313,25 @@ class ServiceAdminServer(HttpJsonServer):
 
         if path in ("/", "/status", "/metrics"):
             return 200, "application/json", _json.dumps(self.service.status())
+        if path == "/metrics.prom":
+            # Flatten the status counters into Prometheus samples (numeric
+            # leaves only), same exposition family as the replica shell.
+            def walk(prefix, obj, out):
+                for k, v in obj.items():
+                    key = f"{prefix}_{k}" if prefix else str(k)
+                    if isinstance(v, dict):
+                        walk(key, v, out)
+                    elif isinstance(v, bool):
+                        out.append((key, int(v)))
+                    elif isinstance(v, (int, float)):
+                        out.append((key, v))
+
+            samples: list = []
+            walk("", self.service.status(), samples)
+            body = "".join(
+                f'mochi_verifier_service{{name="{k}"}} {v}\n' for k, v in samples
+            )
+            return 200, "text/plain; version=0.0.4", body
         return 404, "application/json", '{"error": "not found"}'
 
 

@@ -218,6 +218,17 @@ def test_service_status_counters_and_admin_endpoint():
                 ).read()
             )
             assert json.loads(raw) == st
+
+            prom = (
+                await asyncio.to_thread(
+                    lambda: urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/metrics.prom", timeout=5
+                    ).read()
+                )
+            ).decode()
+            assert 'mochi_verifier_service{name="requests"} 1' in prom
+            assert 'mochi_verifier_service{name="items"} 6' in prom
+            assert 'mochi_verifier_service{name="verifier_hits"} 5' in prom
         finally:
             await admin.close()
             await svc.close()
