@@ -165,3 +165,41 @@ def test_coalescing_verifier_propagates_inner_failure():
             raise AssertionError("inner failure swallowed")
 
     run(main())
+
+
+def test_fan_out_cancellation_cancels_slow_path():
+    """Cancelling a fan-out mid-wait must cancel the detached slow-path
+    task too — no background dials/sends after the caller gave up."""
+
+    async def main():
+        server, port = await _black_hole_server()
+        fast_info = ServerInfo("fast", "127.0.0.1", port)
+        server2, port2 = await _black_hole_server()
+        slow_info = ServerInfo("slow", "127.0.0.1", port2)
+
+        pool = RpcClientPool(default_timeout_s=5.0)
+        await pool._conn(fast_info).ensure_connected()
+
+        task = asyncio.ensure_future(
+            fan_out(
+                pool,
+                [("fast", fast_info), ("slow", slow_info)],
+                lambda msg_id, sid: _env(msg_id),
+                timeout_s=5.0,
+            )
+        )
+        await asyncio.sleep(0.1)  # fan-out is parked in the fast-path wait
+        task.cancel()
+        try:
+            await task
+        except asyncio.CancelledError:
+            pass
+        await asyncio.sleep(0.1)
+        # the slow-path connection must not be left pending a request
+        slow_conn = pool._conn(slow_info)
+        assert not slow_conn.pending, "slow-path request survived cancellation"
+        await pool.close()
+        server.close()
+        server2.close()
+
+    run(main())
