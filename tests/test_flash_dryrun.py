@@ -1,0 +1,48 @@
+"""CPU dry-run of the flash-capture path (scripts/tpu_flash.py).
+
+The flash script is the battery's first action in a live TPU window; a
+bug discovered on-chip would waste the window.  This runs the COMPLETE
+code path — prepare, jit+compile, sequential + pipelined timing with
+per-batch readback, CPU baseline, atomic merge — on the CPU backend with
+a tiny batch, and checks the merge policy (a cpu capture must never
+claim the round headline slot).
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_flash():
+    spec = importlib.util.spec_from_file_location(
+        "tpu_flash", os.path.join(REPO, "scripts", "tpu_flash.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_flash_capture_dryrun(tmp_path, monkeypatch):
+    flash = _load_flash()
+    monkeypatch.setattr(flash, "_REPO", str(tmp_path))
+    os.makedirs(tmp_path / "benchmarks")
+    monkeypatch.setattr(sys, "argv", ["tpu_flash.py", "97"])
+
+    headline = flash.main(batch=32, require_tpu=False)
+    assert headline["metric"] == "ed25519_batch_verify_throughput"
+    assert headline["value"] > 0
+    assert set(headline["pipelined_sigs_per_sec_by_depth"]) == {4, 8}
+
+    out = json.load(open(tmp_path / "benchmarks" / "results_r97_tpu.json"))
+    assert out["flash"]["value"] == headline["value"]
+    # cpu platform must NOT claim the round's headline slot
+    assert "headline" not in out
+
+    # a tpu-platform record does claim it, and only better ones replace it
+    flash.merge_round_results("97", "x", {"platform": "tpu", "value": 10.0})
+    flash.merge_round_results("97", "y", {"platform": "tpu", "value": 5.0})
+    out = json.load(open(tmp_path / "benchmarks" / "results_r97_tpu.json"))
+    assert out["headline"]["value"] == 10.0
