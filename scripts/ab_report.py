@@ -1,0 +1,70 @@
+"""Parse the battery log's A/B ladder legs into one structured record.
+
+VERDICT r3 item 2's deliverable is "a table naming the winning config".
+The battery legs print one line each; this collects them from the round's
+log, names the winner per dimension, and merges an ``ab_ladder`` record
+into ``results_r{N}_tpu.json``.  Defaults are only RECOMMENDED here — a
+human (or next round's builder) flips them after sanity-checking the
+margin, since a single noisy leg must not rewrite production defaults.
+
+Usage: python scripts/ab_report.py <round-suffix>
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import sys
+
+
+def parse(log: str) -> dict:
+    rec: dict = {}
+
+    legs = dict(
+        re.findall(r"^(MOCHI_[A-Z_]+=[\w-]+): best ([\d.]+) sigs/s", log, re.M)
+    )
+    if legs:
+        rec["kernel_legs_sigs_per_sec"] = {k: float(v) for k, v in legs.items()}
+
+    buckets = dict(re.findall(r"^MAX_BUCKET=(\d+): ([\d.]+) sigs/s", log, re.M))
+    if buckets:
+        rec["max_bucket_sigs_per_sec"] = {k: float(v) for k, v in buckets.items()}
+        rec["max_bucket_winner"] = max(buckets, key=lambda k: float(buckets[k]))
+
+    unrolls = dict(re.findall(r"^unroll=(\d+):\s+([\d.]+) sigs/s", log, re.M))
+    if unrolls:
+        rec["unroll_pipelined_sigs_per_sec"] = {
+            k: float(v) for k, v in unrolls.items()
+        }
+        rec["unroll_winner"] = max(unrolls, key=lambda k: float(unrolls[k]))
+
+    # Winner per kernel dimension, vs the defaults leg (the headline bench
+    # runs defaults: per-coord select, pad skew).
+    if legs:
+        sel = {k: v for k, v in legs.items() if k.startswith("MOCHI_SELECT_IMPL")}
+        if sel:
+            rec["select_winner"] = max(sel, key=lambda k: float(sel[k]))
+        base = float(legs.get("MOCHI_SELECT_IMPL=per-coord", 0)) or None
+        mxu = legs.get("MOCHI_SKEW_IMPL=mxu")
+        if base and mxu:
+            rec["mxu_vs_pad_skew"] = round(float(mxu) / base, 3)
+    return rec
+
+
+def main() -> None:
+    round_n = sys.argv[1] if len(sys.argv) > 1 else "04"
+    log = open(f"benchmarks/tpu_measure_r{round_n}.log").read()
+    rec = parse(log)
+    if not rec:
+        print("AB_REPORT: no ladder legs found in the log")
+        return
+    sys.path.insert(0, "scripts")
+    from tpu_flash import merge_round_results
+
+    path = merge_round_results(round_n, "ab_ladder", rec)
+    print("AB_REPORT_JSON " + json.dumps(rec))
+    print("merged ab_ladder ->", path)
+
+
+if __name__ == "__main__":
+    main()

@@ -131,6 +131,10 @@ echo "== 3b2. ladder unroll sweep (fusion scope vs compile time)" | tee -a "$OUT
 timeout 1200 python scripts/unroll_bench.py 8192 2>&1 | tee -a "$OUT"
 step_rc unroll "${PIPESTATUS[0]}"
 
+echo "== 3b3. A/B ladder report (winner table -> results file)" | tee -a "$OUT"
+python scripts/ab_report.py "$ROUND" 2>&1 | tee -a "$OUT"
+step_rc ab_report "${PIPESTATUS[0]}"
+
 echo "== 3c. cycle decomposition (roofline evidence for the MFU story)" | tee -a "$OUT"
 timeout 1200 python scripts/roofline.py 8192 2>&1 | tee -a "$OUT"
 step_rc roofline "${PIPESTATUS[0]}"
