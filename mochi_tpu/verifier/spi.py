@@ -397,6 +397,8 @@ def verifier_stats(verifier) -> dict:
         "fallback_batches",
         "hits",
         "misses",
+        "calls",        # CoalescingVerifier: caller-side verify_batch calls
+        "inner_calls",  # ...vs inner round trips (calls/inner_calls = merge ratio)
     ):
         v = getattr(verifier, attr, None)
         if isinstance(v, int):
