@@ -46,3 +46,25 @@ def test_flash_capture_dryrun(tmp_path, monkeypatch):
     flash.merge_round_results("97", "y", {"platform": "tpu", "value": 5.0})
     out = json.load(open(tmp_path / "benchmarks" / "results_r97_tpu.json"))
     assert out["headline"]["value"] == 10.0
+
+
+def test_ab_report_parses_battery_log():
+    spec = importlib.util.spec_from_file_location(
+        "ab_report", os.path.join(REPO, "scripts", "ab_report.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    log = (
+        "MAX_BUCKET=8192: 91125.3 sigs/s (89.9 ms)\n"
+        "MAX_BUCKET=16384: 54952.9 sigs/s (298.1 ms)\n"
+        "MOCHI_SELECT_IMPL=stacked: best 95000.0 sigs/s at batch 8192\n"
+        "MOCHI_SELECT_IMPL=per-coord: best 91000.0 sigs/s at batch 8192\n"
+        "MOCHI_SKEW_IMPL=mxu: best 101000.0 sigs/s at batch 8192\n"
+        "unroll=2:    104000.0 sigs/s pipelined-4   (compile 30.5s)\n"
+    )
+    rec = mod.parse(log)
+    assert rec["max_bucket_winner"] == "8192"
+    assert rec["select_winner"] == "MOCHI_SELECT_IMPL=stacked"
+    assert rec["mxu_vs_pad_skew"] == 1.11
+    assert rec["unroll_winner"] == "2"
+    assert mod.parse("") == {}
