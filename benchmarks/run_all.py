@@ -77,7 +77,15 @@ def main(argv) -> None:
         _run_child(argv[1])
         return
     publish = "--publish" in argv
-    wanted = [a for a in argv if a != "--publish"] or list(CONFIG_NAMES)
+    # --require-tpu: exit 3 unless every config ran on the chip.  The
+    # battery banks this step as done-for-the-round on rc==0; without the
+    # flag a CPU-fallback run exits 0 (the publish guard only skips
+    # overwriting TPU records, it does not fail the run) and the real TPU
+    # publish never happens (code-review r4 finding).
+    require_tpu = "--require-tpu" in argv
+    wanted = [
+        a for a in argv if a not in ("--publish", "--require-tpu")
+    ] or list(CONFIG_NAMES)
     results = []
     for key in wanted:
         rec = run_one(str(key))
@@ -108,6 +116,15 @@ def main(argv) -> None:
         with open(baseline_path, "w") as fh:
             json.dump(baseline, fh, indent=2)
         print(f"published -> {out_path} and BASELINE.json", file=sys.stderr)
+    if require_tpu:
+        bad = [r.get("config") for r in results if r.get("platform") != "tpu"]
+        if bad:
+            print(
+                f"--require-tpu: configs {bad} did not run on TPU "
+                "(fallback or error); failing so the battery does not bank "
+                "this step", file=sys.stderr,
+            )
+            sys.exit(3)
 
 
 def merge_published(baseline: dict, results: list, round_n: str) -> list:

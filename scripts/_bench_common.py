@@ -1,0 +1,36 @@
+"""Shared helpers for the battery's standalone bench scripts."""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+# The axon TPU plugin force-sets ``jax_platforms=axon,cpu`` via
+# sitecustomize, overriding the JAX_PLATFORMS env var; a CPU validation
+# run (JAX_PLATFORMS=cpu MOCHI_ALLOW_CPU=1) would otherwise burn ~5 min
+# in the axon backend-init watchdog before falling back.  Restore the
+# env var's intent before any backend initializes (same fix as
+# tests/conftest.py).
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+
+def require_tpu(dev) -> None:
+    """Refuse to record a CPU-fallback number as the round's TPU evidence.
+
+    The battery banks a step as done on rc==0 and every retry battery
+    skips banked steps; without this gate a jax CPU fallback (the
+    observed tunnel failure mode) completes inside the step timeout,
+    banks the step, and the real TPU measurement never re-runs this
+    round (code-review r4 finding).  Explicit CPU validation runs set
+    MOCHI_ALLOW_CPU=1.
+    """
+    if os.environ.get("MOCHI_ALLOW_CPU") == "1":
+        return
+    if dev.platform != "tpu":
+        raise SystemExit(
+            f"refusing to measure on platform={dev.platform!r}: this step is "
+            "TPU evidence and would be banked as done (MOCHI_ALLOW_CPU=1 to "
+            "override for CPU validation)"
+        )

@@ -32,6 +32,7 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
 
 sys.path.insert(0, ".")
 
+from _bench_common import require_tpu  # noqa: E402
 from mochi_tpu.crypto import batch_verify, keys  # noqa: E402
 from mochi_tpu.verifier.spi import VerifyItem  # noqa: E402
 
@@ -41,6 +42,7 @@ def main() -> None:
     depth = int(sys.argv[2]) if len(sys.argv) > 2 else 4
     mb = batch_verify.MAX_BUCKET
     dev = jax.devices()[0]
+    require_tpu(dev)
     print(f"device: {dev.platform}, n={n}, MAX_BUCKET={mb}, depth={depth}")
 
     kp = keys.generate_keypair()

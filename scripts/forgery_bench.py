@@ -31,6 +31,7 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
 
 sys.path.insert(0, ".")
 
+from _bench_common import require_tpu  # noqa: E402
 from mochi_tpu.crypto import batch_verify, keys  # noqa: E402
 from mochi_tpu.verifier.spi import VerifyItem  # noqa: E402
 
@@ -38,6 +39,7 @@ from mochi_tpu.verifier.spi import VerifyItem  # noqa: E402
 def main() -> None:
     batch = int(sys.argv[1]) if len(sys.argv) > 1 else 8192
     dev = jax.devices()[0]
+    require_tpu(dev)
     kp = keys.generate_keypair()
     base = []
     for i in range(batch):

@@ -91,7 +91,11 @@ def main() -> None:
         record["skipped"] = "needs the chip (Mosaic compile is the question)"
         _append(out_path, record)
         print("PALLAS_RETRY_JSON " + json.dumps(record))
-        return
+        # Nonzero so the battery does NOT bank this step for the round: a
+        # CPU fallback here means the tunnel died, and exiting 0 would
+        # permanently skip the retry on a later live window (code-review
+        # r4).  75 = EX_TEMPFAIL, matching the battery's tunnel-loss code.
+        sys.exit(75)
 
     for block in (64, 128):
         try:

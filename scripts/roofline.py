@@ -35,6 +35,7 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
 import jax.numpy as jnp
 from jax import lax
 
+from _bench_common import require_tpu
 from mochi_tpu.crypto import curve, field as F
 
 
@@ -85,6 +86,7 @@ def main() -> None:
     pt = curve.Point(a, b, F.one((B,)), a)
     idx = jnp.asarray(rng.integers(0, 9, (B,), dtype=np.int32))
     dev = jax.devices()[0]
+    require_tpu(dev)
     print(f"device: {dev.platform}, batch {B}")
 
     parts = {}

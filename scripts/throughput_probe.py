@@ -1,0 +1,56 @@
+"""verify_batch throughput probe — the shared body of the battery's
+MAX_BUCKET sweep and kernel-formulation A/B legs (one implementation;
+env knobs select the leg, replacing two copy-pasted battery heredocs).
+
+Output lines are parsed by scripts/ab_report.py — keep the formats:
+
+  MAX_BUCKET=8192: 91000.0 sigs/s (90.0 ms)          (bucket leg)
+  MOCHI_SELECT_IMPL=stacked: best 91000.0 sigs/s ... (A/B leg, MOCHI_AB_LEG set)
+
+Usage: [env knobs] python scripts/throughput_probe.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_compilation_cache_dir", ".jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+
+sys.path.insert(0, ".")
+
+from _bench_common import require_tpu  # noqa: E402
+from mochi_tpu.crypto import batch_verify, keys  # noqa: E402
+from mochi_tpu.verifier.spi import VerifyItem  # noqa: E402
+
+
+def main() -> None:
+    require_tpu(jax.devices()[0])
+    n = batch_verify.MAX_BUCKET
+    kp = keys.generate_keypair()
+    items = [
+        VerifyItem(kp.public_key, b"tp%d" % i, kp.sign(b"tp%d" % i))
+        for i in range(n)
+    ]
+    batch_verify.verify_batch(items)  # compile + warm
+    best, best_dt, out = 0.0, float("inf"), None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = batch_verify.verify_batch(items)
+        dt = time.perf_counter() - t0
+        if dt < best_dt:
+            best_dt, best = dt, n / dt
+    assert all(out)
+    leg = os.environ.get("MOCHI_AB_LEG")
+    if leg:
+        print(f"{leg}: best {best:.1f} sigs/s at batch {n}")
+    else:
+        print(f"MAX_BUCKET={n}: {best:.1f} sigs/s ({best_dt * 1e3:.1f} ms)")
+
+
+if __name__ == "__main__":
+    main()

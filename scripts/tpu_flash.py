@@ -23,10 +23,48 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _log(msg: str) -> None:
+    """Timestamped progress marker.
+
+    Round-4 post-mortem (tpu_measure_r04.log 01:04-01:11Z): the flash was
+    killed at its 420 s timeout with no way to tell a slow compile from a
+    tunnel that died mid-compile (round-2 data says the 8192 compile is
+    only ~20-65 s, so it was the tunnel).  Every phase transition now
+    leaves a timestamped line in the battery log.
+    """
+    print(f"[flash {time.strftime('%H:%M:%SZ', time.gmtime())}] {msg}", flush=True)
+
+
+def _commit(paths: list[str], msg: str) -> None:
+    """Self-commit a capture the moment it exists.
+
+    The battery commits after the flash step returns, but a tunnel death
+    mid-flash kills the whole process tree before that commit runs; a
+    2-minute window must leave a *committed* artifact (VERDICT r3 #1).
+    """
+    try:
+        subprocess.run(["git", "add", *paths], cwd=_REPO, check=True, timeout=30)
+        res = subprocess.run(
+            ["git", "commit", "-q", "-m", msg, "--", *paths],
+            cwd=_REPO, timeout=30, capture_output=True,
+        )
+        if res.returncode != 0:
+            # Surface it (index.lock held, hook failure, ...): the caller
+            # believes the capture is now durable, and silence here is
+            # exactly the blindness this banking exists to prevent.
+            _log(
+                f"self-commit FAILED rc={res.returncode}: "
+                f"{(res.stdout + res.stderr).decode(errors='replace').strip()}"
+            )
+    except Exception as exc:  # a commit failure must not kill the capture
+        _log(f"self-commit failed: {exc}")
 
 
 def merge_round_results(round_n: str, key: str, rec: dict) -> str:
@@ -59,6 +97,16 @@ def merge_round_results(round_n: str, key: str, rec: dict) -> str:
     return out_path
 
 
+def flash_already_banked(prior: dict) -> bool:
+    """True only for a COMPLETED live flash capture.
+
+    A mid-run ``flash-seq`` banking (sequential number committed before
+    the pipelined upgrade ran) must NOT satisfy the skip — the retry
+    re-runs cheaply off the primed compile cache and upgrades it.
+    """
+    return prior.get("platform") == "tpu" and prior.get("capture") == "flash"
+
+
 def main(batch: int = 8192, require_tpu: bool = True) -> dict:
     """``batch``/``require_tpu`` exist for the CPU dry-run test — a flash
     bug discovered ON the chip would waste the live window it exists to
@@ -66,6 +114,21 @@ def main(batch: int = 8192, require_tpu: bool = True) -> dict:
     capture-D peak, chip required)."""
     round_n = sys.argv[1] if len(sys.argv) > 1 else "04"
 
+    # Retry batteries re-run the flash first; a window already banked this
+    # round must not be spent re-measuring the same number (the remaining
+    # battery steps need the chip time more).
+    out_path = os.path.join(_REPO, "benchmarks", f"results_r{round_n}_tpu.json")
+    if require_tpu and os.path.exists(out_path):
+        try:
+            with open(out_path) as fh:
+                prior = json.load(fh).get("flash", {})
+        except Exception:
+            prior = {}
+        if flash_already_banked(prior):
+            _log(f"flash already captured this round ({prior.get('value')} sigs/s); skipping")
+            return prior
+
+    _log("importing jax")
     import jax
 
     jax.config.update("jax_compilation_cache_dir", os.path.join(_REPO, ".jax_cache"))
@@ -77,6 +140,7 @@ def main(batch: int = 8192, require_tpu: bool = True) -> dict:
     from mochi_tpu.crypto.curve import verify_prepared
     from mochi_tpu.verifier.spi import VerifyItem
 
+    _log("initializing backend")
     dev = jax.devices()[0]
     if require_tpu:
         assert dev.platform == "tpu", f"flash capture needs the chip, got {dev.platform}"
@@ -92,10 +156,12 @@ def main(batch: int = 8192, require_tpu: bool = True) -> dict:
     )
 
     fn = jax.jit(verify_prepared)
+    _log(f"compile start (batch {batch}; round-2 history: 20-65 s)")
     t0 = time.perf_counter()
     out = jax.block_until_ready(fn(*args))
     compile_s = time.perf_counter() - t0
     assert np.asarray(out).all()
+    _log(f"compile done in {compile_s:.1f}s; measuring")
 
     # Sequential: every batch pays the full dispatch+tunnel round trip.
     seq_times = []
@@ -104,6 +170,29 @@ def main(batch: int = 8192, require_tpu: bool = True) -> dict:
         np.asarray(fn(*args))  # D2H readback = only trustworthy sync on axon
         seq_times.append(time.perf_counter() - t0)
     seq_rate = batch / min(seq_times)
+
+    # Bank the sequential number NOW: the tunnel's observed failure mode is
+    # dying minutes into a window, and a committed sequential capture is
+    # worth far more than an uncommitted pipelined one.
+    if require_tpu:
+        prelim = {
+            "metric": "ed25519_batch_verify_throughput",
+            "value": round(seq_rate, 1),
+            "unit": "sigs/sec",
+            "platform": dev.platform,
+            "impl": "xla",
+            "best_batch": batch,
+            "sequential_sigs_per_sec": round(seq_rate, 1),
+            "compile_s": round(compile_s, 1),
+            "capture": "flash-seq",
+            "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        }
+        path = merge_round_results(round_n, "flash", prelim)
+        _log(f"sequential {seq_rate:.0f} sigs/s banked; committing before pipelined run")
+        _commit(
+            [os.path.relpath(path, _REPO)],
+            f"TPU flash capture r{round_n}: {prelim['value']} sigs/s sequential (live)",
+        )
 
     # Pipelined: several batches in flight, per-batch readback (the loaded
     # BatchingVerifier posture; round-2 methodology).
@@ -141,8 +230,13 @@ def main(batch: int = 8192, require_tpu: bool = True) -> dict:
         "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }
 
-    merge_round_results(round_n, "flash", headline)
+    path = merge_round_results(round_n, "flash", headline)
     print("FLASH_JSON " + json.dumps(headline), flush=True)
+    if require_tpu:
+        _commit(
+            [os.path.relpath(path, _REPO)],
+            f"TPU flash capture r{round_n}: {headline['value']} sigs/s live",
+        )
     return headline
 
 

@@ -31,29 +31,93 @@ cd "$REPO_DIR"
 export PYTHONPATH="${REPO_DIR}${PYTHONPATH:+:$PYTHONPATH}"
 ROUND=${1:-04}
 OUT="benchmarks/tpu_measure_r${ROUND}.log"
+DIAG="benchmarks/tpu_probe_diag_r${ROUND}.log"  # latest probe's jax output
 
 # Failure accounting: set -e would abort the whole battery on one flaky
 # step, but exiting 0 after a mid-run tunnel death would tell the watchdog
 # the battery finished and stop its probe loop (round-3 review finding).
 # Each step reports into FAILED; the battery exits non-zero if any step
 # failed so the watchdog keeps watching for another live window.
+# Stand-down sentinel for the probe-only logger: its per-probe jax init
+# costs ~15 s of the single host core the compiles here need.  Owned by
+# the battery itself (not the watchdog) so manual runs are covered too;
+# the EXIT trap removes it on any normal/SIGTERM death, and the logger
+# additionally ignores sentinels older than 3 h (SIGKILL skips traps).
+touch /tmp/mochi_battery_running
+trap 'rm -f /tmp/mochi_battery_running' EXIT
+
 FAILED=0
-step_rc() {  # step_rc <name> <rc>
+step_rc() {  # step_rc <name> <rc> [device|host]   (default device)
+  # Refresh the sentinel at every step boundary: the probe logger treats
+  # a >3 h-old sentinel as leaked (SIGKILL skips the EXIT trap), and a
+  # full battery legitimately runs longer than that across its steps —
+  # only a single STEP never does.
+  touch /tmp/mochi_battery_running
   if [ "$2" -ne 0 ]; then
     FAILED=$((FAILED + 1))
     echo "[step $1 FAILED rc=$2]" | tee -a "$OUT"
+    # Round-4 lesson (01:04-01:14Z window): once the tunnel dies, every
+    # remaining step burns its FULL timeout blocked in backend init — a
+    # dead tunnel turned a ~90-min battery into ~3 h of waiting with no
+    # probes running.  After a failed DEVICE step, re-probe; if the chip
+    # is gone, commit what we have and hand control back to the
+    # watchdog's cheap 3-min loop (per-milestone resume skips banked
+    # captures).  Host-only steps (log parsing, JSON merges) skip the
+    # re-probe — their failures say nothing about the tunnel and the
+    # probe costs ~15-120 s of live-window host core (code-review r4).
+    [ "${3:-device}" = host ] && return 0
+    if ! bash scripts/tpu_probe.sh 120 "$DIAG"; then
+      echo "[battery] tunnel dead after step $1 — fast abort $(date -u +%FT%TZ)" | tee -a "$OUT"
+      cat "$DIAG" >>"$OUT" 2>/dev/null
+      commit_artifacts "TPU battery r${ROUND}: partial (tunnel died after step $1)"
+      exit 75  # EX_TEMPFAIL: tunnel loss, retry freely (vs rc=1 = real bug)
+    fi
   fi
 }
+
+run_step() {  # run_step <name> <timeout_s> <device|host> <cmd...>
+  # The shared banked-step protocol (skip if banked, run under timeout,
+  # report, bank on success) — one implementation instead of the eight
+  # per-step copies code-review r4 flagged.
+  local name="$1" to="$2" kind="$3" rc
+  shift 3
+  step_done "$name" && return 0
+  timeout "$to" "$@" 2>&1 | tee -a "$OUT"
+  rc="${PIPESTATUS[0]}"
+  step_rc "$name" "$rc" "$kind"
+  [ "$rc" -eq 0 ] && mark_done "$name"
+  return 0
+}
+
+# Per-step banking: retry batteries (the watchdog fires up to 8) must
+# re-run only steps that have not yet SUCCEEDED this round — without
+# this, a tunnel death in a late step re-runs the whole multi-hour tail
+# on every retry, defeating the cheap-retry premise of the raised cap.
+# Steps guard themselves:  step_done X && skip, mark_done X on rc==0.
+DONE_FILE="benchmarks/.battery_steps_r${ROUND}"
+step_done() {
+  if grep -qFx "$1" "$DONE_FILE" 2>/dev/null; then
+    echo "[battery] step $1 already banked this round; skipping" | tee -a "$OUT"
+    return 0
+  fi
+  return 1
+}
+mark_done() { echo "$1" >>"$DONE_FILE"; }
 
 commit_artifacts() {
   git add benchmarks/ BASELINE.json 2>/dev/null
   git commit -q -m "$1" -- benchmarks/ BASELINE.json 2>>"$OUT" || true
 }
 
-echo "== 1. liveness" | tee "$OUT"
-if ! timeout 120 python -c "import jax; d=jax.devices()[0]; assert d.platform=='tpu'; print('chip:', d)" >>"$OUT" 2>&1; then
-  echo "TPU unreachable (see $OUT); aborting before wasting budget" | tee -a "$OUT"
-  exit 1
+# Append (not truncate): retry batteries must not erase the prior
+# attempt's log — it is the post-mortem record and the JSON-merge steps
+# grep it for earlier attempts' structured lines.
+echo "== battery attempt $(date -u +%FT%TZ) ==" | tee -a "$OUT"
+echo "== 1. liveness" | tee -a "$OUT"
+if ! bash scripts/tpu_probe.sh 120 "$DIAG"; then
+  echo "TPU unreachable; aborting before wasting budget — probe diag:" | tee -a "$OUT"
+  cat "$DIAG" >>"$OUT" 2>/dev/null
+  exit 75  # EX_TEMPFAIL: tunnel died between the watchdog's probe and here
 fi
 
 echo "== 1b. flash capture (headline config, committed immediately)" | tee -a "$OUT"
@@ -62,127 +126,125 @@ step_rc flash "${PIPESTATUS[0]}"
 commit_artifacts "TPU flash capture r${ROUND}: live headline measurement"
 
 echo "== 2. headline bench" | tee -a "$OUT"
-MOCHI_BENCH_ROUND="$ROUND" timeout 2400 python bench.py 2>&1 | tee -a "$OUT"
-step_rc bench "${PIPESTATUS[0]}"
-# Merge bench.py's full JSON into the round's results file (it is richer
-# than the flash: per-batch table, MFU, CPU fleet baseline).
-python - "$ROUND" <<'EOF' 2>&1 | tee -a "$OUT"
+# Per-milestone resume: a retry battery must not spend ~8 min of a fresh
+# window re-measuring a bench already banked live this round.
+if python - "$ROUND" <<'EOF'
+import json, sys
+try:
+    doc = json.load(open(f"benchmarks/results_r{sys.argv[1]}_tpu.json"))
+except Exception:
+    sys.exit(1)
+sys.exit(0 if doc.get("bench", {}).get("platform") == "tpu" else 1)
+EOF
+then
+  echo "[battery] bench already banked live this round; skipping" | tee -a "$OUT"
+else
+  MOCHI_BENCH_ROUND="$ROUND" timeout 2400 python bench.py 2>&1 | tee -a "$OUT"
+  step_rc bench "${PIPESTATUS[0]}"
+  # Merge bench.py's full JSON into the round's results file (it is richer
+  # than the flash: per-batch table, MFU, CPU fleet baseline).  Exits 2 on
+  # a CPU fallback so the step_rc probe-gate aborts the battery instead of
+  # letting every later step burn its timeout on a dead tunnel.  Scoped to
+  # THIS attempt's log section: the log is append-only across retries, and
+  # an attempt whose bench printed nothing (e.g. killed at the timeout)
+  # must not silently re-merge a previous attempt's stale record
+  # (code-review r4).
+  python - "$ROUND" <<'EOF' 2>&1 | tee -a "$OUT"
 import json, sys
 sys.path.insert(0, "scripts")
 from tpu_flash import merge_round_results
 round_n = sys.argv[1]
 log = open(f"benchmarks/tpu_measure_r{round_n}.log").read()
-hits = [l for l in log.splitlines() if l.startswith('{"metric"')]
+attempt = log.rsplit("== battery attempt", 1)[-1]
+hits = [l for l in attempt.splitlines() if l.startswith('{"metric"')]
 if hits:
     rec = json.loads(hits[-1])
     print("merged bench.py record into",
           merge_round_results(round_n, "bench", rec))
+    if rec.get("tpu_unreachable"):
+        print("bench fell back to CPU (tpu_unreachable) — flag for the gate")
+        sys.exit(2)
 EOF
-step_rc bench_merge "${PIPESTATUS[0]}"
+  step_rc bench_merge "${PIPESTATUS[0]}"
+fi
 commit_artifacts "TPU measurement battery r${ROUND}: headline bench"
 
 echo "== 3. MAX_BUCKET sweep (8192 was the round-2 peak; check 16384 post-packing)" | tee -a "$OUT"
+# throughput_probe.py is the shared body of 3 and 3b (it refuses CPU
+# fallbacks so a dead-tunnel run can never be banked as TPU evidence).
 for mb in 8192 16384; do
-  MOCHI_MAX_BUCKET=$mb timeout 900 python - <<'EOF' 2>&1 | tee -a "$OUT"
-import os, time, numpy as np, jax
-jax.config.update("jax_compilation_cache_dir", ".jax_cache")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
-from mochi_tpu.crypto import batch_verify, keys
-from mochi_tpu.verifier.spi import VerifyItem
-mb = batch_verify.MAX_BUCKET
-kp = keys.generate_keypair()
-items = [VerifyItem(kp.public_key, b"s%d" % i, kp.sign(b"s%d" % i)) for i in range(mb)]
-batch_verify.verify_batch(items)  # compile
-t0 = time.perf_counter(); out = batch_verify.verify_batch(items)
-dt = time.perf_counter() - t0
-assert all(out)
-print(f"MAX_BUCKET={mb}: {mb/dt:.1f} sigs/s ({dt*1e3:.1f} ms)")
-EOF
-  step_rc "bucket$mb" "${PIPESTATUS[0]}"
+  run_step "bucket$mb" 900 device env "MOCHI_MAX_BUCKET=$mb" python scripts/throughput_probe.py
 done
 
 echo "== 3b. kernel-formulation A/B (select impl; MXU column-reduction multiply)" | tee -a "$OUT"
-# One shared benchmark body; each leg sets one env knob.  The headline
-# (step 2) runs the defaults; MOCHI_SKEW_IMPL=mxu is VERDICT r2 item 2's
-# matmul-reduction formulation probe.
+# Each leg sets one env knob.  The headline (step 2) runs the defaults;
+# MOCHI_SKEW_IMPL=mxu is VERDICT r2 item 2's matmul-reduction probe.
 for leg in "MOCHI_SELECT_IMPL=stacked" "MOCHI_SELECT_IMPL=per-coord" "MOCHI_SKEW_IMPL=mxu"; do
-  env "$leg" MOCHI_AB_LEG="$leg" timeout 900 python - <<'EOF' 2>&1 | tee -a "$OUT"
-import os, time, numpy as np, jax
-jax.config.update("jax_compilation_cache_dir", ".jax_cache")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
-from mochi_tpu.crypto import batch_verify, keys
-from mochi_tpu.verifier.spi import VerifyItem
-kp = keys.generate_keypair()
-n = batch_verify.MAX_BUCKET
-items = [VerifyItem(kp.public_key, b"s%d" % i, kp.sign(b"s%d" % i)) for i in range(n)]
-batch_verify.verify_batch(items)  # compile + warm
-best = 0.0
-for _ in range(3):
-    t0 = time.perf_counter()
-    out = batch_verify.verify_batch(items)
-    best = max(best, n / (time.perf_counter() - t0))
-assert all(out)
-print(f"{os.environ['MOCHI_AB_LEG']}: best {best:.1f} sigs/s at batch {n}")
-EOF
-  step_rc "ab:$leg" "${PIPESTATUS[0]}"
+  run_step "ab:$leg" 900 device env "$leg" "MOCHI_AB_LEG=$leg" python scripts/throughput_probe.py
 done
 
 echo "== 3b2. ladder unroll sweep (fusion scope vs compile time)" | tee -a "$OUT"
-timeout 1200 python scripts/unroll_bench.py 8192 2>&1 | tee -a "$OUT"
-step_rc unroll "${PIPESTATUS[0]}"
+run_step unroll 1200 device python scripts/unroll_bench.py 8192
 
 echo "== 3b3. A/B ladder report (winner table -> results file)" | tee -a "$OUT"
+# Not banked: cheap, and it must re-run after any new legs land.
 python scripts/ab_report.py "$ROUND" 2>&1 | tee -a "$OUT"
-step_rc ab_report "${PIPESTATUS[0]}"
+step_rc ab_report "${PIPESTATUS[0]}" host
 
 echo "== 3c. cycle decomposition (roofline evidence for the MFU story)" | tee -a "$OUT"
-timeout 1200 python scripts/roofline.py 8192 2>&1 | tee -a "$OUT"
-step_rc roofline "${PIPESTATUS[0]}"
+run_step roofline 1200 device python scripts/roofline.py 8192
 
 echo "== 3d. end-to-end vs pipelined on 64k items (goal >=90%)" | tee -a "$OUT"
-timeout 1200 python scripts/e2e_bench.py 65536 2>&1 | tee -a "$OUT"
-step_rc e2e "${PIPESTATUS[0]}"
+run_step e2e 1200 device python scripts/e2e_bench.py 65536
 
 echo "== 3e. forged-fraction throughput sweep (no-cliff proof)" | tee -a "$OUT"
-timeout 900 python scripts/forgery_bench.py 8192 2>&1 | tee -a "$OUT"
-step_rc forgery "${PIPESTATUS[0]}"
+run_step forgery 900 device python scripts/forgery_bench.py 8192
 # Merge the structured e2e/forgery records into the round's results file
 # (the log is committed too, but the JSON file is what the judge greps).
+# Scoped to this attempt's section; earlier attempts' records were merged
+# (and committed) by the attempts that produced them.
 python - "$ROUND" <<'EOF' 2>&1 | tee -a "$OUT"
 import json, sys
 sys.path.insert(0, "scripts")
 from tpu_flash import merge_round_results
 round_n = sys.argv[1]
 log = open(f"benchmarks/tpu_measure_r{round_n}.log").read()
+attempt = log.rsplit("== battery attempt", 1)[-1]
 for tag, key in (("E2E_JSON ", "e2e"), ("FORGERY_JSON ", "forgery")):
-    hits = [l for l in log.splitlines() if l.startswith(tag)]
+    hits = [l for l in attempt.splitlines() if l.startswith(tag)]
     if hits:
         print("merged", key, "->",
               merge_round_results(round_n, key, json.loads(hits[-1][len(tag):])))
 EOF
-step_rc evidence_merge "${PIPESTATUS[0]}"
+step_rc evidence_merge "${PIPESTATUS[0]}" host
 commit_artifacts "TPU battery r${ROUND}: sweeps, A/B ladder, roofline, e2e, forgery"
 
 echo "== 4. publish all configs" | tee -a "$OUT"
-MOCHI_BENCH_ROUND="$ROUND" timeout 5400 python -m benchmarks.run_all --publish 2>&1 | tee -a "$OUT"
-step_rc publish "${PIPESTATUS[0]}"
+# run_all itself refuses to let a CPU-fallback run clobber a live TPU
+# config record (benchmarks/run_all.py fallback guard).
+run_step publish 5400 device env "MOCHI_BENCH_ROUND=$ROUND" python -m benchmarks.run_all --publish --require-tpu
 commit_artifacts "TPU battery r${ROUND}: run_all publish"
 
 echo "== 5. config1 via shared TPU verifier service" | tee -a "$OUT"
-timeout 1200 python -c "
-import jax, json
+# require_tpu: config1_cluster silently substitutes CpuVerifier when the
+# backend is not TPU — that run must not be banked as the TPU-service
+# measurement (code-review r4).
+run_step config1_service 1200 device python -c "
+import sys, json
+sys.path.insert(0, 'scripts')
+import jax
 jax.config.update('jax_compilation_cache_dir', '.jax_cache')
+from _bench_common import require_tpu
+require_tpu(jax.devices()[0])
 from benchmarks import config1_cluster
 print(json.dumps(config1_cluster.run(5, 40, 2, verifier='service')))
-" 2>&1 | tee -a "$OUT"
-step_rc config1_service "${PIPESTATUS[0]}"
+"
 
 echo "== 6. bounded Pallas retry (time-boxed; VERDICT r3 #9)" | tee -a "$OUT"
 # 1800s outer budget: two 600s legs + jax init + 3 timed runs per
 # successful leg must fit with margin, else the parent is SIGTERMed and
 # the DID-NOT-FINISH record is lost.
-timeout 1800 python scripts/pallas_retry.py 600 2>&1 | tee -a "$OUT"
-step_rc pallas_retry "${PIPESTATUS[0]}"
+run_step pallas_retry 1800 device python scripts/pallas_retry.py 600
 commit_artifacts "TPU battery r${ROUND}: config1 service + pallas retry"
 
 echo "DONE (failed_steps=$FAILED) — artifacts committed per-milestone; see benchmarks/results_r${ROUND}_tpu.json and $OUT" | tee -a "$OUT"

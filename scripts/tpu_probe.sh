@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# Shared TPU liveness probe — THE single implementation (code-review r4:
+# four divergent inline copies risked fixes missing a site).
+#
+# Real device work with np.asarray readback (block_until_ready through
+# the axon relay is untrustworthy), persistent compile cache wired so
+# repeat probes skip the matmul compile.  Exit 0 = chip alive.
+#
+# Diagnostics go to $2 (OVERWRITTEN each probe — latest-failure
+# semantics, bounded size; the round-4 post-mortem lacked the
+# backend-init traceback).  Default /dev/null for callers that only
+# need the verdict.
+#
+# Usage: scripts/tpu_probe.sh [timeout-seconds] [diag-file]
+set -uo pipefail
+cd "$(dirname "$0")/.."
+DIAG="${2:-/dev/null}"
+{ echo "[probe] $(date -u +%FT%TZ) timeout=${1:-120}s"; } >"$DIAG" 2>/dev/null || true
+exec timeout "${1:-120}" python -u - <<'EOF' >>"$DIAG" 2>&1
+import jax, numpy as np, jax.numpy as jnp
+jax.config.update("jax_compilation_cache_dir", ".jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+d = jax.devices()[0]
+assert d.platform == "tpu", f"platform={d.platform}"
+y = jnp.ones((128, 128), jnp.bfloat16) @ jnp.ones((128, 128), jnp.bfloat16)
+assert float(np.asarray(y)[0, 0]) == 128.0
+print("probe OK:", d)
+EOF

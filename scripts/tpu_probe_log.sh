@@ -1,0 +1,67 @@
+#!/usr/bin/env bash
+# Probe-only tunnel logger: record live/dead timestamps WITHOUT firing
+# the battery.  Two jobs:
+#   - survey window frequency/duration (the round-4 post-mortem could
+#     not tell a slow compile from a dead tunnel because nothing probed
+#     while the flash compiled);
+#   - fallback observer while the main watchdog is down (e.g. its
+#     scripts are being edited — bash re-reads scripts incrementally,
+#     so the watchdog must be stopped during edits).
+# It stands down whenever the watchdog is alive (the watchdog's own
+# probe loop already logs dead probes) or a battery is running — a probe
+# costs ~15 s of the single host core.
+set -uo pipefail
+REPO_DIR=$(cd "$(dirname "$0")/.." && pwd)
+cd "$REPO_DIR"
+ROUND=${1:-04}
+LOG="benchmarks/tpu_probe_r${ROUND}.log"
+LOCKFILE="/tmp/mochi_tpu_probe.lock"
+SENTINEL="/tmp/mochi_battery_running"
+exec 9>"$LOCKFILE"
+flock -n 9 || { echo "[probe-log] already running"; exit 0; }
+
+battery_active() {
+  # Sentinel check WITH the 3 h staleness guard everywhere (a SIGKILLed
+  # battery skips its EXIT trap and leaks the file; the battery
+  # re-touches it at every step boundary, so >3 h old == leaked).
+  [ -e "$SENTINEL" ] && [ -n "$(find "$SENTINEL" -mmin -180 2>/dev/null)" ]
+}
+
+watchdog_alive() {
+  # Process check, NOT a lock probe: briefly acquiring the watchdog's
+  # flock to test it opens a window where a watchdog starting at that
+  # instant sees its lock held and exits "already running" — silently
+  # leaving no watchdog at all (code-review r4 finding).
+  pgrep -f "tpu_watchdog\.sh" >/dev/null 2>&1
+}
+
+echo "[probe-log] start $(date -u +%FT%TZ)" >>"$LOG"
+while true; do
+  if battery_active || watchdog_alive; then
+    sleep 60
+    continue
+  fi
+  # Probe in the background and watch for the battery sentinel: a probe
+  # already in flight when a battery fires must be killed, not waited
+  # out — its jax init contends with the flash compile on the single
+  # host core.
+  bash scripts/tpu_probe.sh 120 "benchmarks/tpu_probe_diag_r${ROUND}.log" &
+  probe_pid=$!
+  killed=""
+  while kill -0 "$probe_pid" 2>/dev/null; do
+    if battery_active; then
+      kill "$probe_pid" 2>/dev/null
+      killed=1
+    fi
+    sleep 2
+  done
+  if [ -n "$killed" ]; then
+    wait "$probe_pid" 2>/dev/null  # reap: an endless loop must not accrue zombies
+    echo "[probe-log] probe killed (battery started) $(date -u +%FT%TZ)" >>"$LOG"
+  elif wait "$probe_pid"; then
+    echo "[probe-log] LIVE $(date -u +%FT%TZ)" >>"$LOG"
+  else
+    echo "[probe-log] dead $(date -u +%FT%TZ)" >>"$LOG"
+  fi
+  sleep 100
+done

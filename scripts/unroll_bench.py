@@ -21,6 +21,7 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
 
 sys.path.insert(0, ".")
 
+from _bench_common import require_tpu  # noqa: E402
 from mochi_tpu.crypto import batch_verify, curve, keys  # noqa: E402
 from mochi_tpu.verifier.spi import VerifyItem  # noqa: E402
 
@@ -28,6 +29,7 @@ from mochi_tpu.verifier.spi import VerifyItem  # noqa: E402
 def main():
     batch = int(sys.argv[1]) if len(sys.argv) > 1 else 8192
     dev = jax.devices()[0]
+    require_tpu(dev)
     print(f"device: {dev.platform}  batch={batch}")
     kp = keys.generate_keypair()
     items = [

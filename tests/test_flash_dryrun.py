@@ -48,6 +48,29 @@ def test_flash_capture_dryrun(tmp_path, monkeypatch):
     assert out["headline"]["value"] == 10.0
 
 
+def test_flash_skips_when_already_banked(tmp_path, monkeypatch):
+    """A retry battery must not spend a fresh live window re-measuring a
+    completed flash — but a mid-run 'flash-seq' banking must NOT skip (the
+    pipelined upgrade still needs to run)."""
+    flash = _load_flash()
+
+    # The discrimination itself (capture kind + platform), directly:
+    assert flash.flash_already_banked({"platform": "tpu", "capture": "flash"})
+    assert not flash.flash_already_banked({"platform": "tpu", "capture": "flash-seq"})
+    assert not flash.flash_already_banked({"platform": "cpu", "capture": "flash"})
+    assert not flash.flash_already_banked({})
+
+    # And main()'s early return actually consults it (before any backend
+    # work, so require_tpu=True is safe on the CPU-only test host):
+    monkeypatch.setattr(flash, "_REPO", str(tmp_path))
+    os.makedirs(tmp_path / "benchmarks")
+    monkeypatch.setattr(sys, "argv", ["tpu_flash.py", "98"])
+    path = tmp_path / "benchmarks" / "results_r98_tpu.json"
+    done = {"platform": "tpu", "capture": "flash", "value": 111000.0}
+    path.write_text(json.dumps({"flash": done}))
+    assert flash.main(batch=32, require_tpu=True) == done
+
+
 def test_ab_report_parses_battery_log():
     spec = importlib.util.spec_from_file_location(
         "ab_report", os.path.join(REPO, "scripts", "ab_report.py")
