@@ -170,6 +170,14 @@ def run_cluster_ycsb(
 
     async def _ycsb_cluster(factory, platform, service):
         async with VirtualCluster(5, rf=4, verifier_factory=factory) as vc:
+            # Register the replica identities with the service's comb
+            # registry: grant-certificate traffic is signed exclusively by
+            # these n keys, so it takes the doubling-free comb path
+            # (crypto/comb.py) — the production posture for cluster verify.
+            if hasattr(service.verifier, "register_signers"):
+                service.verifier.register_signers(
+                    list(vc.config.public_keys.values())
+                )
             # preload the keyspace so reads hit existing keys — batched
             # into multi-write transactions (16 keys each) instead of 64
             # sequential round trips of untimed setup

@@ -58,12 +58,36 @@ def run(n: int = 64, f: int = 21, rounds: int = 4) -> Dict:
     counts = np.bincount(group_ids, weights=bitmap.astype(np.int64), minlength=n)
     assert (counts >= quorum).all()
 
+    # Comb leg: the production posture — the n replica identities are a
+    # KNOWN signer set, so the storm takes the doubling-free comb path
+    # (crypto/comb.py).  Same verdict contract, ~3x fewer device FLOPs.
+    from mochi_tpu.crypto import comb
+
+    reg = comb.SignerRegistry()
+    reg.register_all([kp.public_key for kp in server_keys])
+    key_idx = np.asarray(
+        [reg.index_of(it.public_key) for it in items], dtype=np.int32
+    )
+    comb_prep = comb._prepare_comb(items, key_idx, None)
+    comb_best = float("inf")
+    launched = comb._dispatch_comb(comb_prep, reg, None)  # compile
+    assert all(
+        np.logical_and(np.asarray(launched[0])[: len(items)], launched[1])
+    )
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        launched = comb._dispatch_comb(comb_prep, reg, None)
+        np.asarray(launched[0])
+        comb_best = min(comb_best, time.perf_counter() - t0)
+
     return {
         "metric": "view_change_storm_validate",
         "value": round(best * 1e3, 2),
         "unit": "ms",
         "sigs": len(items),
         "sigs_per_sec": round(len(items) / best, 1),
+        "comb_ms": round(comb_best * 1e3, 2),
+        "comb_sigs_per_sec": round(len(items) / comb_best, 1),
         "n": n,
         "f": f,
         "quorum": quorum,
