@@ -253,6 +253,8 @@ def verify_batch(
     items: Sequence[VerifyItem],
     device: Optional[jax.Device] = None,
     bucket: Optional[int] = None,
+    registry=None,
+    comb_gen: Optional[int] = None,
 ) -> List[bool]:
     """Verify a batch of Ed25519 signatures on the default JAX device.
 
@@ -260,9 +262,66 @@ def verify_batch(
     rejected on host; padding lanes carry pre_ok=False and are sliced away.
     ``bucket`` forces a specific padded size (callers that know which program
     shapes are already compiled use it to avoid a fresh compile).
+
+    ``registry`` (a :class:`mochi_tpu.crypto.comb.SignerRegistry`) enables
+    the known-signer comb path: items whose public key is registered run
+    the doubling-free comb kernel (~3x fewer field muls — comb.py
+    docstring); the rest take the general ladder below.  Verdicts are
+    identical either way (``tests/test_comb.py``); disable with
+    ``MOCHI_COMB=0``.  ``comb_gen`` pins the registry generation the
+    CALLER checked comb-readiness against: keys registered after that
+    generation route to the general ladder (their table rows may not be
+    in the pinned device table), and the device table keeps the pinned
+    generation's shape so no retrace can hit this call.
     """
     if not items:
         return []
+    if registry is not None and len(registry) and comb_enabled():
+        from . import comb
+
+        comb_pos: List[int] = []
+        kidx: List[int] = []
+        gen_pos: List[int] = []
+        for i, it in enumerate(items):
+            k = registry.index_of(it.public_key)
+            if k is None or (comb_gen is not None and k >= comb_gen):
+                gen_pos.append(i)
+            else:
+                comb_pos.append(i)
+                kidx.append(k)
+        if comb_pos:
+            comb_items = [items[i] for i in comb_pos]
+            key_arr = np.asarray(kidx, dtype=np.int32)
+            if not gen_pos:
+                return comb.verify_stream(
+                    comb_items, key_arr, registry, device, bucket, comb_gen
+                )
+            gen_items = [items[i] for i in gen_pos]
+            if len(comb_items) <= MAX_BUCKET and len(gen_items) <= MAX_BUCKET:
+                # Mixed batch, both subsets single-chunk: DISPATCH both
+                # programs before reading either back, so the two device
+                # launches overlap instead of serializing on the first
+                # readback (JAX dispatch is async).
+                comb_launched = comb._dispatch_comb(
+                    comb._prepare_comb(comb_items, key_arr, bucket),
+                    registry,
+                    device,
+                    registry.device_table(device, comb_gen),
+                )
+                gen_launched = _launch(gen_items, device, bucket)
+                comb_out = _readback(comb_launched, len(comb_items))
+                gen_out = _readback(gen_launched, len(gen_items))
+            else:
+                comb_out = comb.verify_stream(
+                    comb_items, key_arr, registry, device, bucket, comb_gen
+                )
+                gen_out = verify_batch(gen_items, device, bucket)
+            out: List[bool] = [False] * len(items)
+            for i, v in zip(comb_pos, comb_out):
+                out[i] = v
+            for i, v in zip(gen_pos, gen_out):
+                out[i] = v
+            return out
     if len(items) > MAX_BUCKET and bucket is None:
         # Two-level pipeline behind a bounded window, live memory
         # O(depth * MAX_BUCKET) instead of O(request):
@@ -354,11 +413,10 @@ def _dispatch(prepared, device: Optional[jax.Device] = None):
     spend real signing-grade work (canonical encodings) to buy device
     time; byte noise is absorbed at host precheck rates
     (scripts/forgery_bench.py measures both)."""
-    global _device_dispatches
     use_pallas, args, pre_ok = prepared
     if not pre_ok.any():
         return None, pre_ok
-    _device_dispatches += 1
+    _note_dispatch()
     if device is not None:
         args = tuple(jax.device_put(a, device) for a in args)
     if use_pallas:
@@ -375,9 +433,42 @@ def _dispatch(prepared, device: Optional[jax.Device] = None):
 # (the stall the ready/chunking machinery exists to prevent).
 _device_dispatches = 0
 
+# Per-thread dispatch counters: readiness attribution must not observe
+# OTHER threads' dispatches (BatchingVerifier runs up to max_inflight
+# backend calls concurrently; a global-delta read would let thread B's
+# dispatch mark thread A's bucket ready without a compile — code-review
+# r4).  All dispatching happens on the calling thread (the prepare worker
+# only packs), so thread-local deltas attribute exactly.
+_tls = threading.local()
+
+
+_comb_device_dispatches = 0
+
+
+def _note_dispatch(comb: bool = False) -> None:
+    global _device_dispatches, _comb_device_dispatches
+    _device_dispatches += 1
+    if comb:
+        _comb_device_dispatches += 1
+        _tls.comb = getattr(_tls, "comb", 0) + 1
+    else:
+        _tls.general = getattr(_tls, "general", 0) + 1
+
+
+def thread_dispatch_counts() -> tuple:
+    """(general, comb) dispatches made by THIS thread (monotone)."""
+    return (getattr(_tls, "general", 0), getattr(_tls, "comb", 0))
+
 
 def device_dispatch_count() -> int:
     return _device_dispatches
+
+
+def comb_enabled() -> bool:
+    """Operator kill switch for the known-signer comb path.  Checked by
+    routing AND by every comb compile site — MOCHI_COMB=0 must not keep
+    paying 20-60 s comb compiles for programs that will never run."""
+    return os.environ.get("MOCHI_COMB", "1") != "0"
 
 
 def _launch(
@@ -414,8 +505,11 @@ class JaxBatchBackend:
         device: Optional[jax.Device] = None,
         min_device_items: Optional[int] = None,
         verify_fn=None,
+        registry=None,
     ):
         self.device = device
+        # Known-signer comb registry (crypto/comb.py); None = ladder only.
+        self.registry = registry
         # Hook for alternative device paths (the mesh-sharded backend in
         # verifier/tpu.py) so they inherit the crossover + warmup +
         # compile-stall machinery below instead of re-implementing it.
@@ -439,19 +533,80 @@ class JaxBatchBackend:
         self._ready: set[int] = set()
         self._compiling: set[int] = set()
         self._failed: set[int] = set()
+        # Comb readiness is per (bucket, registry generation): capacity
+        # growth changes the device-table SHAPE, invalidating every comb
+        # compile, and live traffic must never park behind the recompile —
+        # a stale bucket routes through the (compiled) general ladder
+        # while the comb program re-warms in the background.
+        self._ready_comb: dict = {}  # bucket -> generation compiled at
+        self._comb_compiling: set = set()  # (bucket, generation)
         self._lock = threading.Lock()
 
-    def _call_verify(self, items, bucket: Optional[int] = None):
+    def _comb_pinned_gen(self, bucket: int) -> Optional[int]:
+        """Generation a comb program is provably compiled for at this
+        bucket, or None.  An OLD generation stays valid forever: keys
+        registered after it simply route to the general ladder (the
+        ``comb_gen`` clamp in :func:`verify_batch`), so registry growth
+        never interrupts comb service — it only leaves the new keys on
+        the ladder until the background re-warm lands."""
+        if self.registry is None or not len(self.registry) or not comb_enabled():
+            return None
+        return self._ready_comb.get(bucket)
+
+    def _call_verify(
+        self,
+        items,
+        bucket: Optional[int] = None,
+        use_comb=False,
+        comb_gen: Optional[int] = None,
+    ):
         fn = self._verify_fn if self._verify_fn is not None else verify_batch
+        if use_comb and fn is verify_batch:
+            return fn(
+                items,
+                device=self.device,
+                bucket=bucket,
+                registry=self.registry,
+                comb_gen=comb_gen,
+            )
         return fn(items, device=self.device, bucket=bucket)
 
+    def _warm_comb(self, bucket: int) -> None:
+        """Compile the comb program for one bucket (synchronous; callers
+        choose the thread) and record the generation it covers.  The
+        compile runs against THAT generation's table shape — later
+        dispatches pin the same generation, so the compiled program is
+        exactly the one they hit."""
+        from . import comb
+
+        gen = self.registry.generation
+        comb.warmup(self.registry, [bucket], self.device, gen=gen)
+        with self._lock:
+            # monotone: never regress a bucket below a generation another
+            # warm already covered
+            self._ready_comb[bucket] = max(gen, self._ready_comb.get(bucket, 0))
+
     def warmup(self, batch_sizes: Sequence[int]) -> None:
-        """Synchronously pre-compile the given bucket sizes (boot path)."""
+        """Synchronously pre-compile the given bucket sizes (boot path).
+
+        With a registry attached this warms BOTH programs per bucket: the
+        dummy items exercise the general ladder (their throwaway key is
+        never registered), and ``comb.warmup`` compiles the comb program —
+        otherwise the first live batch of registered-signer traffic would
+        park behind a synchronous compile, exactly the stall the
+        ready-bucket machinery exists to prevent."""
         for n in batch_sizes:
             bucket = _bucket_size(n)
             self._call_verify(_dummy_items(bucket))
             with self._lock:
                 self._ready.add(bucket)
+            if (
+                self.registry is not None
+                and len(self.registry)
+                and self._verify_fn is None
+                and comb_enabled()
+            ):
+                self._warm_comb(bucket)
 
     def _compile_in_background(self, bucket: int) -> None:
         def run():
@@ -460,6 +615,13 @@ class JaxBatchBackend:
                 self._call_verify(items)
                 with self._lock:
                     self._ready.add(bucket)
+                if (
+                    self.registry is not None
+                    and len(self.registry)
+                    and self._verify_fn is None
+                    and comb_enabled()
+                ):
+                    self._warm_comb(bucket)
             except Exception:
                 LOG.exception(
                     "background compile of verify bucket %d failed; "
@@ -474,6 +636,35 @@ class JaxBatchBackend:
 
         threading.Thread(target=run, name=f"verify-warm-{bucket}", daemon=True).start()
 
+    def _comb_compile_in_background(self, bucket: int) -> None:
+        """Re-warm a stale comb program (new bucket or registry growth)
+        without blocking the caller's traffic (which keeps serving: comb
+        at its pinned older generation, new keys on the ladder)."""
+        if not comb_enabled() or self.registry is None or not len(self.registry):
+            return
+        gen = self.registry.generation
+        with self._lock:
+            if (bucket, gen) in self._comb_compiling:
+                return
+            self._comb_compiling.add((bucket, gen))
+
+        def run():
+            try:
+                self._warm_comb(bucket)
+            except Exception:
+                LOG.exception(
+                    "background comb compile (bucket %d) failed; traffic "
+                    "stays on the general ladder",
+                    bucket,
+                )
+            finally:
+                with self._lock:
+                    self._comb_compiling.discard((bucket, gen))
+
+        threading.Thread(
+            target=run, name=f"comb-warm-{bucket}", daemon=True
+        ).start()
+
     def __call__(self, items: Sequence[VerifyItem]) -> Sequence[bool]:
         if len(items) < self.min_device_items:
             from . import keys as _keys
@@ -483,41 +674,106 @@ class JaxBatchBackend:
                 for it in items
             ]
         bucket = _bucket_size(len(items))
+        registry_active = self.registry is not None and len(self.registry)
+        pinned = self._comb_pinned_gen(bucket)
         with self._lock:
-            ready_now = bucket in self._ready
+            general_ready = bucket in self._ready
             ready = sorted(self._ready)
+            comb_ready_buckets = sorted(self._ready_comb)
+            anything = bool(ready) or bool(comb_ready_buckets)
             schedule = (
-                not ready_now
-                and bool(ready)
+                not general_ready
+                and anything
                 and bucket not in self._compiling
                 and bucket not in self._failed
             )
             if schedule:
                 self._compiling.add(bucket)
-        if ready_now or not ready:
+        use_comb = pinned is not None
+        if (
+            registry_active
+            and comb_enabled()
+            and anything
+            and (pinned is None or pinned < self.registry.generation)
+        ):
+            # Comb program missing for this bucket, or compiled before the
+            # latest registrations: serve THIS batch as-is (ladder, or
+            # comb at the pinned older generation) and re-warm off the
+            # hot path so the new keys join the comb path shortly.  Not
+            # gated on general readiness: comb-only traffic never
+            # populates _ready at all (code-review r4).
+            self._comb_compile_in_background(bucket)
+        # Direct serve when this bucket has a compiled program for its
+        # traffic: the general program, or — for registered-signer traffic
+        # — the comb program alone (an unregistered leftover in that
+        # posture is rare enough to accept its one-off compile), or when
+        # NOTHING is compiled yet (first ever call eats the compile;
+        # servers avoid it via boot warmup).
+        ready_now = general_ready or (use_comb and registry_active)
+        if ready_now or not anything:
             # Bucket compiled, or nothing compiled yet (first ever call):
             # run directly (the latter eats one synchronous compile — servers
             # avoid it via boot-time warmup).  Only a call that actually
             # dispatched the device program proves the bucket is compiled;
             # the all-rejected fast path skips the device and must not mark
-            # readiness.
-            before = device_dispatch_count()
-            out = self._call_verify(items)
-            if device_dispatch_count() > before:
+            # readiness.  With a registry, comb and general dispatches are
+            # counted separately — each program proves only ITS OWN
+            # readiness (a comb-only dispatch must not green-light the
+            # general program, or a later mixed batch stalls on a
+            # "ready" bucket).
+            if registry_active and not anything and comb_enabled():
+                # first-ever call, nothing compiled: pin the current
+                # generation and eat both compiles synchronously
+                use_comb = True
+                pinned = self.registry.generation
+            gen = pinned if use_comb else None
+            # bucket is passed explicitly (when it is a single launch) so
+            # a MIXED batch's subsets pad to this compiled shape instead
+            # of their own smaller, never-compiled natural buckets; an
+            # oversize batch keeps bucket=None so verify_batch's bounded
+            # MAX_BUCKET launch-window pipeline still applies
+            # (code-review r4, both directions).
+            explicit = bucket if bucket <= MAX_BUCKET else None
+            general_before, comb_before = thread_dispatch_counts()
+            out = self._call_verify(
+                items, bucket=explicit, use_comb=use_comb, comb_gen=gen
+            )
+            general_after, comb_after = thread_dispatch_counts()
+            comb_n = comb_after - comb_before
+            general_n = general_after - general_before
+            if explicit is not None:
                 with self._lock:
-                    self._ready.add(bucket)
+                    if general_n > 0:
+                        self._ready.add(bucket)
+                    if comb_n > 0 and gen is not None:
+                        self._ready_comb[bucket] = max(
+                            gen, self._ready_comb.get(bucket, 0)
+                        )
             return out
         if schedule:
             self._compile_in_background(bucket)
-        # Serve via already-compiled shapes only: chunk at the largest ready
-        # bucket and pad each chunk up to the smallest ready bucket that fits,
-        # so no chunk can trigger a synchronous compile.
-        largest_ready = ready[-1]
+        # Serve via already-compiled shapes only: chunk at the largest
+        # compiled bucket and pad each chunk up to the smallest compiled
+        # bucket that fits, so no chunk can trigger a synchronous compile.
+        # General-program buckets serve any traffic; with comb-only
+        # history (registered-signer service without boot warmup) the
+        # comb buckets serve instead — an unregistered leftover there is
+        # the rare accept-one-compile case documented above.
+        targets = ready if ready else comb_ready_buckets
+        largest_ready = targets[-1]
         out: List[bool] = []
         for i in range(0, len(items), largest_ready):
             chunk = items[i : i + largest_ready]
-            target = next(b for b in ready if b >= len(chunk))
-            out.extend(self._call_verify(chunk, bucket=target))
+            target = next(b for b in targets if b >= len(chunk))
+            tgt_gen = self._comb_pinned_gen(target)
+            out.extend(
+                self._call_verify(
+                    chunk,
+                    bucket=target,
+                    use_comb=tgt_gen is not None,
+                    comb_gen=tgt_gen,
+                )
+            )
         return out
 
 

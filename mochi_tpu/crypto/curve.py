@@ -471,13 +471,18 @@ def verify_prepared_packed(
     end-to-end batches shipped through a remote-device tunnel
     (measured: the (B, 256) int32 bit tensors are ~8 MB per 8192-chunk
     each; the byte forms are 256 KB)."""
+    return verify_prepared(
+        y_a, sign_a, y_r, sign_r, unpack_bits(s_bytes), unpack_bits(h_bytes)
+    )
 
-    def unpack(b):  # (B, 32) uint8 -> (B, 256) int32 LE bits
-        shifts = jnp.arange(8, dtype=jnp.uint8)
-        bits = (b[:, :, None] >> shifts[None, None, :]) & 1
-        return bits.reshape(b.shape[0], 256).astype(jnp.int32)
 
-    return verify_prepared(y_a, sign_a, y_r, sign_r, unpack(s_bytes), unpack(h_bytes))
+def unpack_bits(b: jnp.ndarray) -> jnp.ndarray:
+    """(B, 32) uint8 packed scalar bytes -> (B, 256) int32 LE bits — the
+    on-device half of the packed-transfer format (shared with the comb
+    path, :mod:`mochi_tpu.crypto.comb`)."""
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    bits = (b[:, :, None] >> shifts[None, None, :]) & 1
+    return bits.reshape(b.shape[0], 256).astype(jnp.int32)
 
 
 def verify_prepared(

@@ -15,6 +15,7 @@ batcher feeds the device in-process — one IPC hop less on the hot path.
 
 from __future__ import annotations
 
+import threading
 from typing import Optional, Sequence
 
 import jax
@@ -41,9 +42,16 @@ class TpuBatchVerifier(BatchingVerifier):
         warmup_buckets: Sequence[int] = (),
         min_device_items: Optional[int] = None,
         max_inflight: int = 4,
+        signers: Sequence[bytes] = (),
     ):
+        registry = None
+        if signers:
+            from ..crypto.comb import SignerRegistry
+
+            registry = SignerRegistry(device=device)
+            registry.register_all(signers)
         jax_backend = JaxBatchBackend(
-            device=device, min_device_items=min_device_items
+            device=device, min_device_items=min_device_items, registry=registry
         )
         super().__init__(
             backend=jax_backend,
@@ -52,8 +60,47 @@ class TpuBatchVerifier(BatchingVerifier):
             fallback=fallback,
             max_inflight=max_inflight,
         )
+        self._device = device
+        self._warmup_buckets = tuple(warmup_buckets)
+        self._registry_lock = threading.Lock()
         if warmup_buckets:
             jax_backend.warmup(warmup_buckets)
+
+    def register_signers(self, pubs: Sequence[bytes]) -> None:
+        """Late signer registration (a cluster registering its replica
+        identities after boot, or live reconfiguration adding a server).
+
+        Safe while traffic flows: the backend routes a bucket through comb
+        only when the comb program is compiled for the CURRENT registry
+        generation, so growth never parks live batches behind a recompile
+        — they stay on the (compiled) general ladder while comb re-warms
+        in the background.  This method re-warms the known buckets eagerly
+        so the comb path activates without waiting for the next cold
+        batch.  The lock closes the check-then-create race between two
+        concurrent registrars (the loser's keys would land in an orphaned
+        registry)."""
+        backend = self.backend
+        with self._registry_lock:
+            if backend.registry is None:
+                from ..crypto.comb import SignerRegistry
+
+                backend.registry = SignerRegistry(device=self._device)
+            before = backend.registry.generation
+            backend.registry.register_all(pubs)
+            grew = backend.registry.generation != before
+        if grew:
+            # Re-warm every bucket any program family has served — comb-only
+            # buckets included (a service whose traffic is 100% registered
+            # never populates _ready, only _ready_comb — code-review r4).
+            # Warmup sizes map through _bucket_size: readiness keys are
+            # always bucketized powers of two.
+            from ..crypto.batch_verify import _bucket_size
+
+            with backend._lock:
+                buckets = set(backend._ready) | set(backend._ready_comb)
+            buckets |= {_bucket_size(int(b)) for b in self._warmup_buckets}
+            for bucket in sorted(buckets):
+                backend._comb_compile_in_background(bucket)
 
 
 class ShardedJaxBatchBackend(JaxBatchBackend):
@@ -102,7 +149,7 @@ class ShardedJaxBatchBackend(JaxBatchBackend):
             # like the base _dispatch fast path — no dispatch-count bump,
             # so the bucket is not falsely marked compiled.
             return [False] * len(items)
-        batch_verify._device_dispatches += 1
+        batch_verify._note_dispatch()
         n = len(items)
         m = batch_verify._bucket_size(n) if bucket is None else bucket
         # static shapes for the compile cache, rounded up to a device

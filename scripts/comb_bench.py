@@ -1,0 +1,127 @@
+"""Comb vs ladder A/B: known-signer verification throughput.
+
+Measures the doubling-free comb path (crypto/comb.py) against the general
+ladder at the headline bucket, with the signer-set size of the cluster
+workloads (config 3: n=16; config 4: n=64) — every item signed by one of K
+registered keys, which is exactly the cluster's verify traffic shape
+(grant certificates and view-change votes come from replica identities).
+
+Output lines (parsed by scripts/ab_report.py):
+
+  COMB K=16: 210000.0 sigs/s (39.0 ms)   vs LADDER: 91000.0 sigs/s -> 2.31x
+
+Readback discipline: np.asarray inside the timed region (through the axon
+relay block_until_ready is untrustworthy — BASELINE.md).
+
+Usage: [MOCHI_ALLOW_CPU=1] [COMB_BATCH=8192] [COMB_SIGNERS=16,64]
+       python scripts/comb_bench.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_compilation_cache_dir", ".jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+
+sys.path.insert(0, ".")
+sys.path.insert(0, "scripts")
+
+from _bench_common import require_tpu  # noqa: E402
+from mochi_tpu.crypto import batch_verify, comb, keys  # noqa: E402
+from mochi_tpu.verifier.spi import VerifyItem  # noqa: E402
+
+
+def _items(kps, n):
+    out = []
+    for i in range(n):
+        kp = kps[i % len(kps)]
+        msg = b"comb-bench-%d" % i
+        out.append(VerifyItem(kp.public_key, msg, kp.sign(msg)))
+    return out
+
+
+def _time_best(fn, reps=3):
+    best = float("inf")
+    out = None
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn()  # each fn ends in readback (np.asarray via verify_batch)
+        dt = time.perf_counter() - t0
+        best = min(best, dt)
+    return best, out
+
+
+def main() -> None:
+    require_tpu(jax.devices()[0])
+    n = int(os.environ.get("COMB_BATCH", str(batch_verify.MAX_BUCKET)))
+    signer_counts = [
+        int(k) for k in os.environ.get("COMB_SIGNERS", "16,64").split(",") if k
+    ]
+
+    # --- ladder baseline (same items as the K=first leg)
+    kps = [keys.generate_keypair() for _ in range(max(signer_counts))]
+    items = _items(kps[: signer_counts[0]], n)
+    t0 = time.perf_counter()
+    batch_verify.verify_batch(items)  # compile + warm
+    print(f"ladder compile+warm {time.perf_counter() - t0:.1f}s", flush=True)
+    ladder_dt, ladder_out = _time_best(lambda: batch_verify.verify_batch(items))
+    assert all(ladder_out)
+    ladder_rate = n / ladder_dt
+    print(f"LADDER: {ladder_rate:.1f} sigs/s ({ladder_dt * 1e3:.1f} ms)", flush=True)
+    results = {
+        "batch": n,
+        "ladder_sigs_per_sec": round(ladder_rate, 1),
+        "comb_by_signers": {},
+    }
+
+    for k in signer_counts:
+        reg = comb.SignerRegistry()
+        reg.register_all([kp.public_key for kp in kps[:k]])
+        items = _items(kps[:k], n)
+        t0 = time.perf_counter()
+        batch_verify.verify_batch(items, registry=reg)  # compile + warm
+        print(
+            f"comb K={k} compile+warm {time.perf_counter() - t0:.1f}s", flush=True
+        )
+        dt, out = _time_best(
+            lambda: batch_verify.verify_batch(items, registry=reg)
+        )
+        assert all(out)
+        rate = n / dt
+        print(
+            f"COMB K={k}: {rate:.1f} sigs/s ({dt * 1e3:.1f} ms)   "
+            f"vs LADDER: {ladder_rate:.1f} sigs/s -> {rate / ladder_rate:.2f}x",
+            flush=True,
+        )
+        results["comb_by_signers"][str(k)] = {
+            "sigs_per_sec": round(rate, 1),
+            "speedup_vs_ladder": round(rate / ladder_rate, 3),
+        }
+
+    # correctness spot check on-device: forgeries must still be caught
+    bad = items[:64]
+    bad = [
+        VerifyItem(it.public_key, it.message, it.signature[:5] + bytes([it.signature[5] ^ 1]) + it.signature[6:])
+        for it in bad
+    ]
+    reg = comb.SignerRegistry()
+    reg.register_all([kp.public_key for kp in kps])
+    assert not any(
+        batch_verify.verify_batch(bad, registry=reg)
+    ), "comb accepted forged signatures"
+    print("forgery spot-check OK", flush=True)
+
+    import json
+
+    print("COMB_JSON " + json.dumps(results), flush=True)
+
+
+if __name__ == "__main__":
+    main()

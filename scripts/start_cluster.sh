@@ -35,9 +35,20 @@ if [ "$VERIFIER" = "remote" ]; then
     (umask 077 && python -c "import os; print(os.urandom(32).hex())" > "$OUT/verifier.secret")
   fi
   chmod 600 "$OUT/verifier.secret"
+  # Known-signer registration: cert traffic is signed by the replica
+  # identities in the cluster config, so hand them to the service's comb
+  # registry (crypto/comb.py — the doubling-free device fast path).
+  python - "$OUT" <<'PYEOF'
+import json, sys
+doc = json.load(open(f"{sys.argv[1]}/cluster_config.json"))
+with open(f"{sys.argv[1]}/signers.txt", "w") as f:
+    for sid, hexkey in sorted(doc.get("public_keys", {}).items()):
+        f.write(f"{hexkey}  # {sid}\n")
+PYEOF
   python -m mochi_tpu.verifier.service --port "$VPORT" \
     --backend "${MOCHI_VERIFIER_BACKEND:-tpu}" \
     --secret-file "$OUT/verifier.secret" \
+    --signers-file "$OUT/signers.txt" \
     --admin-port $((VPORT + 1)) \
     >"$OUT/log/verifier.log" 2>&1 &
   PIDS+=($!)
