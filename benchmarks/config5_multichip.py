@@ -99,6 +99,27 @@ def run(batch_per_device: int = 0, n_groups: int = 64, iters: int = 3) -> Dict:
         "path": "ShardedJaxBatchBackend (packed production path, end-to-end)",
     }
 
+    # ---- comb leg: registered-signer traffic on the same backend --------
+    # (the production posture — service --signers-file; ~3x fewer device
+    # FLOPs per item, comb.py)
+    backend.register_signers([kp.public_key])
+    out = backend._sharded_verify(
+        items, registry=backend.registry, comb_gen=backend.registry.generation
+    )  # compile + warm
+    assert all(out)
+    comb_best = float("inf")
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = backend._sharded_verify(
+            items,
+            registry=backend.registry,
+            comb_gen=backend.registry.generation,
+        )
+        comb_best = min(comb_best, time.perf_counter() - t0)
+    assert all(out)
+    rec["comb_sigs_per_sec"] = round(b / comb_best, 1)
+    rec["comb_vs_ladder"] = round(best / comb_best, 2)
+
     # ---- decomposition at the same total batch --------------------------
     y_a, sign_a, y_r, sign_r, s_sc, h_sc, pre_ok = batch_verify.prepare_packed(items)
     assert pre_ok.all()

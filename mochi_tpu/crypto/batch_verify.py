@@ -540,7 +540,43 @@ class JaxBatchBackend:
         # while the comb program re-warms in the background.
         self._ready_comb: dict = {}  # bucket -> generation compiled at
         self._comb_compiling: set = set()  # (bucket, generation)
+        # Buckets whose comb compile FAILED — latched like _failed so a
+        # persistently failing shape doesn't re-attempt a 20-60 s compile
+        # on every batch; such buckets serve on the general path.
+        self._comb_failed: set = set()
         self._lock = threading.Lock()
+        self._registry_mutex = threading.Lock()
+
+    def _comb_capable(self) -> bool:
+        """Whether this backend's device path can route through the comb
+        kernel (the sharded subclass overrides with its own comb program)."""
+        return self._verify_fn is None
+
+    def _registry_device(self):
+        """Placement for the registry's device tables (the sharded subclass
+        returns a replicated NamedSharding instead of a single device)."""
+        return self.device
+
+    def register_signers(self, pubs: Sequence[bytes], extra_buckets=()) -> None:
+        """Register known signers (cluster replica identities), creating
+        the registry on first use.  Thread-safe; growth never stalls live
+        traffic (already-registered signers keep comb at their pinned
+        generation, new keys ride the general path until the background
+        re-warms here finish)."""
+        with self._registry_mutex:
+            if self.registry is None:
+                from .comb import SignerRegistry
+
+                self.registry = SignerRegistry(device=self._registry_device())
+            before = self.registry.generation
+            self.registry.register_all(pubs)
+            grew = self.registry.generation != before
+        if grew:
+            with self._lock:
+                buckets = set(self._ready) | set(self._ready_comb)
+            buckets |= {_bucket_size(int(b)) for b in extra_buckets}
+            for bucket in sorted(buckets):
+                self._comb_compile_in_background(bucket)
 
     def _comb_pinned_gen(self, bucket: int) -> Optional[int]:
         """Generation a comb program is provably compiled for at this
@@ -549,7 +585,12 @@ class JaxBatchBackend:
         ``comb_gen`` clamp in :func:`verify_batch`), so registry growth
         never interrupts comb service — it only leaves the new keys on
         the ladder until the background re-warm lands."""
-        if self.registry is None or not len(self.registry) or not comb_enabled():
+        if (
+            self.registry is None
+            or not len(self.registry)
+            or not comb_enabled()
+            or not self._comb_capable()
+        ):
             return None
         return self._ready_comb.get(bucket)
 
@@ -561,7 +602,7 @@ class JaxBatchBackend:
         comb_gen: Optional[int] = None,
     ):
         fn = self._verify_fn if self._verify_fn is not None else verify_batch
-        if use_comb and fn is verify_batch:
+        if use_comb and self._comb_capable():
             return fn(
                 items,
                 device=self.device,
@@ -603,7 +644,7 @@ class JaxBatchBackend:
             if (
                 self.registry is not None
                 and len(self.registry)
-                and self._verify_fn is None
+                and self._comb_capable()
                 and comb_enabled()
             ):
                 self._warm_comb(bucket)
@@ -618,7 +659,7 @@ class JaxBatchBackend:
                 if (
                     self.registry is not None
                     and len(self.registry)
-                    and self._verify_fn is None
+                    and self._comb_capable()
                     and comb_enabled()
                 ):
                     self._warm_comb(bucket)
@@ -644,7 +685,7 @@ class JaxBatchBackend:
             return
         gen = self.registry.generation
         with self._lock:
-            if (bucket, gen) in self._comb_compiling:
+            if (bucket, gen) in self._comb_compiling or bucket in self._comb_failed:
                 return
             self._comb_compiling.add((bucket, gen))
 
@@ -653,10 +694,12 @@ class JaxBatchBackend:
                 self._warm_comb(bucket)
             except Exception:
                 LOG.exception(
-                    "background comb compile (bucket %d) failed; traffic "
-                    "stays on the general ladder",
+                    "comb compile (bucket %d) failed; bucket latched — its "
+                    "traffic stays on the general path",
                     bucket,
                 )
+                with self._lock:
+                    self._comb_failed.add(bucket)
             finally:
                 with self._lock:
                     self._comb_compiling.discard((bucket, gen))
@@ -689,6 +732,14 @@ class JaxBatchBackend:
             )
             if schedule:
                 self._compiling.add(bucket)
+        if schedule:
+            # Kick the background GENERAL compile here — before any serve
+            # path returns.  The comb direct-serve branch below returns
+            # without reaching the chunked fallback, and scheduling-
+            # without-starting would leak the bucket in _compiling forever,
+            # permanently disabling background compiles for it
+            # (code-review r4).
+            self._compile_in_background(bucket)
         use_comb = pinned is not None
         if (
             registry_active
@@ -750,8 +801,6 @@ class JaxBatchBackend:
                             gen, self._ready_comb.get(bucket, 0)
                         )
             return out
-        if schedule:
-            self._compile_in_background(bucket)
         # Serve via already-compiled shapes only: chunk at the largest
         # compiled bucket and pad each chunk up to the smallest compiled
         # bucket that fits, so no chunk can trigger a synchronous compile.

@@ -132,6 +132,33 @@ def make_sharded_verify_packed(mesh: Mesh):
     return verify
 
 
+def make_sharded_verify_comb(mesh: Mesh):
+    """Batch-sharded KNOWN-SIGNER comb verify (``crypto/comb.py``): the
+    signature tensors shard over the batch axis while the per-signer comb
+    table (a few MB for a 64-replica cluster) is REPLICATED to every
+    device — each chip gathers from its local copy, so the path stays
+    collective-free like the general sharded verify.  ~3x fewer field muls
+    per item than the ladder (comb.py docstring)."""
+    from ..crypto import comb
+
+    spec = P(BATCH_AXIS)
+    rep = P()
+    sharding = NamedSharding(mesh, spec)
+
+    @partial(jax.jit, out_shardings=sharding)
+    def verify(table, key_idx, y_r, sign_r, s_bytes, h_bytes):
+        f = shard_map(
+            comb.verify_comb_prepared,
+            mesh=mesh,
+            in_specs=(rep, spec, spec, spec, spec, spec),
+            out_specs=spec,
+            check_vma=False,
+        )
+        return f(table, key_idx, y_r, sign_r, s_bytes, h_bytes)
+
+    return verify
+
+
 def make_quorum_step(mesh: Mesh, n_groups: int):
     """Jitted full distributed step: sharded verify + cross-chip quorum tally.
 

@@ -269,13 +269,12 @@ async def amain(args) -> None:
     signers: List[bytes] = (
         load_signers(args.signers_file) if args.signers_file else []
     )
-    if signers and args.backend != "tpu":
-        # The comb fast path is single-device today; failing silently would
-        # hide a missing ~3x from the operator (code-review r4).
+    if signers and args.backend == "cpu":
+        # Failing silently would hide a missing ~3x from the operator
+        # (code-review r4); the CPU backend has no device comb path.
         LOG.warning(
-            "--signers-file is only used by --backend tpu (got %r): "
-            "verification stays on the general path",
-            args.backend,
+            "--signers-file has no effect with --backend cpu: "
+            "verification runs OpenSSL per item",
         )
     verifier: Optional[SignatureVerifier] = None
     if args.backend == "cpu":
@@ -298,12 +297,14 @@ async def amain(args) -> None:
 
         t0 = time.time()
         verifier = ShardedTpuBatchVerifier(
-            warmup_buckets=tuple(int(b) for b in args.warmup.split(",") if b)
+            warmup_buckets=tuple(int(b) for b in args.warmup.split(",") if b),
+            signers=signers,
         )
         LOG.info(
-            "sharded verifier over %d devices (warmup %.1fs)",
+            "sharded verifier over %d devices (warmup %.1fs, %d known signers)",
             verifier.backend.n_devices,
             time.time() - t0,
+            len(signers),
         )
     secret = None
     if args.secret_file:

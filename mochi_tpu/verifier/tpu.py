@@ -15,7 +15,6 @@ batcher feeds the device in-process — one IPC hop less on the hot path.
 
 from __future__ import annotations
 
-import threading
 from typing import Optional, Sequence
 
 import jax
@@ -62,7 +61,6 @@ class TpuBatchVerifier(BatchingVerifier):
         )
         self._device = device
         self._warmup_buckets = tuple(warmup_buckets)
-        self._registry_lock = threading.Lock()
         if warmup_buckets:
             jax_backend.warmup(warmup_buckets)
 
@@ -70,37 +68,11 @@ class TpuBatchVerifier(BatchingVerifier):
         """Late signer registration (a cluster registering its replica
         identities after boot, or live reconfiguration adding a server).
 
-        Safe while traffic flows: the backend routes a bucket through comb
-        only when the comb program is compiled for the CURRENT registry
-        generation, so growth never parks live batches behind a recompile
-        — they stay on the (compiled) general ladder while comb re-warms
-        in the background.  This method re-warms the known buckets eagerly
-        so the comb path activates without waiting for the next cold
-        batch.  The lock closes the check-then-create race between two
-        concurrent registrars (the loser's keys would land in an orphaned
-        registry)."""
-        backend = self.backend
-        with self._registry_lock:
-            if backend.registry is None:
-                from ..crypto.comb import SignerRegistry
-
-                backend.registry = SignerRegistry(device=self._device)
-            before = backend.registry.generation
-            backend.registry.register_all(pubs)
-            grew = backend.registry.generation != before
-        if grew:
-            # Re-warm every bucket any program family has served — comb-only
-            # buckets included (a service whose traffic is 100% registered
-            # never populates _ready, only _ready_comb — code-review r4).
-            # Warmup sizes map through _bucket_size: readiness keys are
-            # always bucketized powers of two.
-            from ..crypto.batch_verify import _bucket_size
-
-            with backend._lock:
-                buckets = set(backend._ready) | set(backend._ready_comb)
-            buckets |= {_bucket_size(int(b)) for b in self._warmup_buckets}
-            for bucket in sorted(buckets):
-                backend._comb_compile_in_background(bucket)
+        Safe while traffic flows — see
+        :meth:`mochi_tpu.crypto.batch_verify.JaxBatchBackend
+        .register_signers`: growth never parks live batches behind a
+        recompile; the warmed buckets re-warm eagerly in the background."""
+        self.backend.register_signers(pubs, extra_buckets=self._warmup_buckets)
 
 
 class ShardedJaxBatchBackend(JaxBatchBackend):
@@ -123,19 +95,64 @@ class ShardedJaxBatchBackend(JaxBatchBackend):
     single-device path.
     """
 
-    def __init__(self, mesh=None, min_device_items: Optional[int] = None):
-        from ..parallel.sharded import make_mesh, make_sharded_verify_packed
+    def __init__(
+        self, mesh=None, min_device_items: Optional[int] = None, registry=None
+    ):
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from ..parallel.sharded import (
+            make_mesh,
+            make_sharded_verify_comb,
+            make_sharded_verify_packed,
+        )
 
         self.mesh = mesh if mesh is not None else make_mesh()
         self.n_devices = int(self.mesh.devices.size)
         self._sharded = make_sharded_verify_packed(self.mesh)
+        self._sharded_comb = make_sharded_verify_comb(self.mesh)
+        # comb tables replicate to every device (a few MB; each chip
+        # gathers locally — no collective)
+        self._rep_sharding = NamedSharding(self.mesh, PartitionSpec())
         super().__init__(
             device=None,
             min_device_items=min_device_items,
             verify_fn=self._sharded_verify,
+            registry=registry,
         )
 
-    def _sharded_verify(self, items, device=None, bucket=None):
+    def _comb_capable(self) -> bool:
+        return True
+
+    def _registry_device(self):
+        return self._rep_sharding
+
+    def _warm_comb(self, bucket: int) -> None:
+        """Compile the sharded comb program for one bucket (the base warms
+        the single-device program, which is not the one this backend
+        dispatches)."""
+        import numpy as np
+
+        from ..crypto import batch_verify, field as F
+
+        gen = self.registry.generation
+        m = ((bucket + self.n_devices - 1) // self.n_devices) * self.n_devices
+        table = self.registry.device_table(self._rep_sharding, gen)
+        np.asarray(
+            self._sharded_comb(
+                table,
+                np.zeros((m,), np.int32),
+                np.zeros((m, F.NLIMBS), np.int32),
+                np.zeros((m,), np.int32),
+                np.zeros((m, 32), np.uint8),
+                np.zeros((m, 32), np.uint8),
+            )
+        )
+        with self._lock:
+            self._ready_comb[bucket] = max(gen, self._ready_comb.get(bucket, 0))
+
+    def _sharded_verify(
+        self, items, device=None, bucket=None, registry=None, comb_gen=None
+    ):
         import numpy as np
 
         from ..crypto import batch_verify
@@ -143,13 +160,30 @@ class ShardedJaxBatchBackend(JaxBatchBackend):
         del device  # placement comes from the mesh sharding
         if not items:
             return []
+        # Comb routing is all-or-nothing per launch: a mixed batch runs the
+        # general program whole rather than paying two sharded launches —
+        # cluster service traffic is ~100% registered, so the split case
+        # is rare enough that simplicity wins.
+        use_comb = (
+            registry is not None
+            and len(registry)
+            and batch_verify.comb_enabled()
+        )
+        key_idx = None
+        gen = None
+        if use_comb:
+            gen = comb_gen if comb_gen is not None else registry.generation
+            idxs = [registry.index_of(it.public_key) for it in items]
+            if any(k is None or k >= gen for k in idxs):
+                use_comb = False
+            else:
+                key_idx = np.asarray(idxs, dtype=np.int32)
         y_a, sign_a, y_r, sign_r, s_sc, h_sc, pre_ok = batch_verify.prepare_packed(items)
         if not pre_ok.any():
             # All-rejected chunk (garbage flood): no device work, and —
             # like the base _dispatch fast path — no dispatch-count bump,
             # so the bucket is not falsely marked compiled.
             return [False] * len(items)
-        batch_verify._note_dispatch()
         n = len(items)
         m = batch_verify._bucket_size(n) if bucket is None else bucket
         # static shapes for the compile cache, rounded up to a device
@@ -164,7 +198,19 @@ class ShardedJaxBatchBackend(JaxBatchBackend):
             h_sc = np.pad(h_sc, pad2)
             sign_a = np.pad(sign_a, ((0, m - n),))
             sign_r = np.pad(sign_r, ((0, m - n),))
-        bitmap = np.asarray(self._sharded(y_a, sign_a, y_r, sign_r, s_sc, h_sc))[:n]
+            if key_idx is not None:
+                key_idx = np.pad(key_idx, ((0, m - n),))
+        if use_comb:
+            batch_verify._note_dispatch(comb=True)
+            table = self.registry.device_table(self._rep_sharding, gen)
+            bitmap = np.asarray(
+                self._sharded_comb(table, key_idx, y_r, sign_r, s_sc, h_sc)
+            )[:n]
+        else:
+            batch_verify._note_dispatch()
+            bitmap = np.asarray(
+                self._sharded(y_a, sign_a, y_r, sign_r, s_sc, h_sc)
+            )[:n]
         return [bool(b) for b in np.logical_and(bitmap, pre_ok)]
 
 
@@ -180,10 +226,13 @@ class ShardedTpuBatchVerifier(BatchingVerifier):
         warmup_buckets: Sequence[int] = (),
         min_device_items: Optional[int] = None,
         max_inflight: int = 4,
+        signers: Sequence[bytes] = (),
     ):
         backend = ShardedJaxBatchBackend(
             mesh=mesh, min_device_items=min_device_items
         )
+        if signers:
+            backend.register_signers(signers)
         super().__init__(
             backend=backend,
             max_batch=max_batch,
@@ -191,5 +240,11 @@ class ShardedTpuBatchVerifier(BatchingVerifier):
             fallback=fallback,
             max_inflight=max_inflight,
         )
+        self._warmup_buckets = tuple(warmup_buckets)
         if warmup_buckets:
             backend.warmup(warmup_buckets)
+
+    def register_signers(self, pubs: Sequence[bytes]) -> None:
+        """Late signer registration for the sharded backend — same no-stall
+        semantics as the single-device verifier."""
+        self.backend.register_signers(pubs, extra_buckets=self._warmup_buckets)

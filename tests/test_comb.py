@@ -255,6 +255,32 @@ def test_comb_only_service_chunks_at_comb_buckets(signers):
     assert backend._comb_pinned_gen(32) is None  # not synchronously compiled
 
 
+def test_sharded_comb_matches_openssl_on_cpu_mesh(signers):
+    """Sharded comb (shard_map over the 8-device CPU mesh, table
+    replicated) produces the same bitmap as OpenSSL — the config-5 /
+    multi-chip production posture."""
+    from mochi_tpu.verifier.tpu import ShardedJaxBatchBackend
+
+    backend = ShardedJaxBatchBackend(min_device_items=0)
+    backend.register_signers([kp.public_key for kp in signers])
+    assert backend.n_devices > 1  # conftest forces the 8-device CPU mesh
+    items = _mixed_items(signers, n=40)
+    expect = _expected(items)
+    assert list(backend(items)) == expect
+    # comb program actually dispatched (all signers registered)
+    before = comb.comb_dispatch_count()
+    assert list(backend(items)) == expect
+    assert comb.comb_dispatch_count() > before
+
+    # a mixed batch with an unregistered signer runs the general sharded
+    # program whole (all-or-nothing routing) — verdicts still exact
+    stranger = keys.generate_keypair()
+    mixed = items[:6] + [VerifyItem(stranger.public_key, b"s", stranger.sign(b"s"))]
+    before = comb.comb_dispatch_count()
+    assert list(backend(mixed)) == _expected(mixed)
+    assert comb.comb_dispatch_count() == before
+
+
 def test_comb_table_math_against_host_ints(signers):
     """The device comb table rows really are [d*16^w](-A) in Niels form:
     rebuild one entry from host ints and compare limbs."""
