@@ -571,7 +571,7 @@ class JaxBatchBackend:
             before = self.registry.generation
             self.registry.register_all(pubs)
             grew = self.registry.generation != before
-        if grew:
+        if grew and self._comb_capable():
             with self._lock:
                 buckets = set(self._ready) | set(self._ready_comb)
             buckets |= {_bucket_size(int(b)) for b in extra_buckets}

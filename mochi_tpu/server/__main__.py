@@ -52,8 +52,12 @@ async def amain(args) -> None:
             raise SystemExit(f"TPU verifier unavailable ({exc}); use --verifier cpu") from exc
         # Warm the XLA cache at boot (first compile is 20-60s; doing it here
         # keeps it out of the first client's commit latency) — READY is only
-        # printed once the verifier can serve.
-        verifier = TpuBatchVerifier(warmup_buckets=(16,))
+        # printed once the verifier can serve.  The cluster's replica
+        # identities are known signers: their cert signatures take the
+        # doubling-free comb path (crypto/comb.py).
+        verifier = TpuBatchVerifier(
+            warmup_buckets=(16,), signers=list(config.public_keys.values())
+        )
     elif args.verifier.startswith("remote:"):
         # Shared TPU sidecar: one mochi_tpu.verifier.service process owns the
         # chip; every replica ships its signature batches there (the north

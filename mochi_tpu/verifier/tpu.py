@@ -23,7 +23,18 @@ from ..crypto.batch_verify import JaxBatchBackend
 from .spi import BatchingVerifier, SignatureVerifier
 
 
-class TpuBatchVerifier(BatchingVerifier):
+class _SignerRegistrationMixin:
+    """Shared one-liner delegating signer registration to the backend (both
+    verifier classes store ``_warmup_buckets``; keeping ONE definition
+    avoids silent divergence).  See
+    :meth:`mochi_tpu.crypto.batch_verify.JaxBatchBackend.register_signers`
+    for the no-stall growth semantics."""
+
+    def register_signers(self, pubs: Sequence[bytes]) -> None:
+        self.backend.register_signers(pubs, extra_buckets=self._warmup_buckets)
+
+
+class TpuBatchVerifier(_SignerRegistrationMixin, BatchingVerifier):
     """BatchingVerifier over the JAX device backend.
 
     ``max_batch``/``max_delay_s`` implement the batching discipline of
@@ -63,17 +74,6 @@ class TpuBatchVerifier(BatchingVerifier):
         self._warmup_buckets = tuple(warmup_buckets)
         if warmup_buckets:
             jax_backend.warmup(warmup_buckets)
-
-    def register_signers(self, pubs: Sequence[bytes]) -> None:
-        """Late signer registration (a cluster registering its replica
-        identities after boot, or live reconfiguration adding a server).
-
-        Safe while traffic flows — see
-        :meth:`mochi_tpu.crypto.batch_verify.JaxBatchBackend
-        .register_signers`: growth never parks live batches behind a
-        recompile; the warmed buckets re-warm eagerly in the background."""
-        self.backend.register_signers(pubs, extra_buckets=self._warmup_buckets)
-
 
 class ShardedJaxBatchBackend(JaxBatchBackend):
     """``JaxBatchBackend`` whose device path shards each batch over a MESH.
@@ -192,17 +192,19 @@ class ShardedJaxBatchBackend(JaxBatchBackend):
         m = ((m + self.n_devices - 1) // self.n_devices) * self.n_devices
         if m != n:
             pad2 = ((0, m - n), (0, 0))
-            y_a = np.pad(y_a, pad2)
             y_r = np.pad(y_r, pad2)
             s_sc = np.pad(s_sc, pad2)
             h_sc = np.pad(h_sc, pad2)
-            sign_a = np.pad(sign_a, ((0, m - n),))
             sign_r = np.pad(sign_r, ((0, m - n),))
-            if key_idx is not None:
+            if use_comb:
                 key_idx = np.pad(key_idx, ((0, m - n),))
+            else:
+                # only the general program reads the pubkey tensors
+                y_a = np.pad(y_a, pad2)
+                sign_a = np.pad(sign_a, ((0, m - n),))
         if use_comb:
             batch_verify._note_dispatch(comb=True)
-            table = self.registry.device_table(self._rep_sharding, gen)
+            table = registry.device_table(self._rep_sharding, gen)
             bitmap = np.asarray(
                 self._sharded_comb(table, key_idx, y_r, sign_r, s_sc, h_sc)
             )[:n]
@@ -214,7 +216,7 @@ class ShardedJaxBatchBackend(JaxBatchBackend):
         return [bool(b) for b in np.logical_and(bitmap, pre_ok)]
 
 
-class ShardedTpuBatchVerifier(BatchingVerifier):
+class ShardedTpuBatchVerifier(_SignerRegistrationMixin, BatchingVerifier):
     """BatchingVerifier over the mesh-sharded backend (all local devices)."""
 
     def __init__(
@@ -244,7 +246,3 @@ class ShardedTpuBatchVerifier(BatchingVerifier):
         if warmup_buckets:
             backend.warmup(warmup_buckets)
 
-    def register_signers(self, pubs: Sequence[bytes]) -> None:
-        """Late signer registration for the sharded backend — same no-stall
-        semantics as the single-device verifier."""
-        self.backend.register_signers(pubs, extra_buckets=self._warmup_buckets)
