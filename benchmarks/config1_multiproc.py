@@ -96,6 +96,7 @@ def run(
     n_clients: int = 8,
     keys_per_client: int = 12,
     sweeps: int = 2,
+    uds: bool = False,
 ) -> Dict:
     from mochi_tpu.utils.runtime import tune_gc_for_server
 
@@ -112,7 +113,8 @@ def run(
                 sys.executable, "-m", "mochi_tpu.tools.gen_cluster",
                 "--out-dir", out, "--servers", str(n_servers), "--rf", str(rf),
                 "--base-port", "9301",
-            ],
+            ]
+            + (["--uds"] if uds else []),
             check=True, env=env, capture_output=True,
         )
         cfg = os.path.join(out, "cluster_config.json")
@@ -152,6 +154,14 @@ def run(
                     try:
                         import socket
 
+                        if info.is_unix:
+                            s = socket.socket(socket.AF_UNIX)
+                            s.settimeout(0.5)
+                            try:
+                                s.connect(info.unix_path)
+                                break
+                            finally:
+                                s.close()
                         with socket.create_connection((info.host, info.port), 0.5):
                             break
                     except OSError:

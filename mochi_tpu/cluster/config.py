@@ -77,10 +77,23 @@ class ServerInfo:
 
     @classmethod
     def from_url(cls, server_id: str, url: str) -> "ServerInfo":
-        host, _, port = url.partition(":")
-        if not port:
+        """``host:port``, or ``unix:<path>:0`` for a Unix-domain socket
+        (local clusters: skips the loopback TCP/IP stack — the kernel
+        send-path is the measured cost floor on single-host deployments).
+        rpartition: a UDS path contains ':' after the scheme."""
+        host, _, port = url.rpartition(":")
+        if not host or not port:
             raise ValueError(f"bad server url (want host:port): {url!r}")
         return cls(server_id=server_id, host=host, port=int(port))
+
+    @property
+    def is_unix(self) -> bool:
+        return self.host.startswith("unix:")
+
+    @property
+    def unix_path(self) -> str:
+        assert self.is_unix
+        return self.host[len("unix:"):]
 
     @property
     def url(self) -> str:

@@ -221,13 +221,28 @@ class RpcServer:
 
     async def start(self) -> None:
         loop = asyncio.get_running_loop()
-        self._server = await loop.create_server(
-            lambda: _RpcServerProtocol(self), self.host, self.port
-        )
+        if self.host.startswith("unix:"):
+            # Unix-domain socket: same framed protocol, no TCP/IP stack —
+            # the kernel loopback send path is the measured cost floor for
+            # single-host clusters (BASELINE.md round-4 note).
+            path = self.host[len("unix:"):]
+            try:
+                os.unlink(path)  # stale socket from a previous run
+            except FileNotFoundError:
+                pass
+            self._server = await loop.create_unix_server(
+                lambda: _RpcServerProtocol(self), path
+            )
+        else:
+            self._server = await loop.create_server(
+                lambda: _RpcServerProtocol(self), self.host, self.port
+            )
 
     @property
     def bound_port(self) -> int:
         assert self._server is not None
+        if self.host.startswith("unix:"):
+            return self.port  # UDS has no port; identity stays the path
         return self._server.sockets[0].getsockname()[1]
 
     async def close(self) -> None:
@@ -240,6 +255,13 @@ class RpcServer:
                     proto.transport.close()
             await self._server.wait_closed()
             self._server = None
+            if self.host.startswith("unix:"):
+                # a stale socket file accepts nothing but still looks alive
+                # to path-probing consumers — ENOENT beats ECONNREFUSED
+                try:
+                    os.unlink(self.host[len("unix:"):])
+                except OSError:
+                    pass
 
 
 class _RpcClientProtocol(_FramedProtocol):
@@ -293,9 +315,16 @@ class _Connection:
             last_exc: Optional[Exception] = None
             for _ in range(retries):
                 try:
-                    _, proto = await loop.create_connection(
-                        lambda: _RpcClientProtocol(self), self.info.host, self.info.port
-                    )
+                    if self.info.is_unix:
+                        _, proto = await loop.create_unix_connection(
+                            lambda: _RpcClientProtocol(self), self.info.unix_path
+                        )
+                    else:
+                        _, proto = await loop.create_connection(
+                            lambda: _RpcClientProtocol(self),
+                            self.info.host,
+                            self.info.port,
+                        )
                     self._proto = proto
                     return
                 except OSError as exc:

@@ -37,6 +37,7 @@ class VirtualCluster:
         require_client_auth: bool = False,
         host: str = "127.0.0.1",
         shed_lag_ms: float = 30.0,
+        uds_dir: Optional[str] = None,
     ):
         self.n_servers = n_servers
         self.rf = rf
@@ -44,6 +45,17 @@ class VirtualCluster:
         self.require_client_auth = require_client_auth
         self.host = host
         self.shed_lag_ms = shed_lag_ms
+        # Unix-domain sockets instead of loopback TCP (per-replica socket
+        # files under this dir): skips the TCP/IP stack on the kernel send
+        # path, the measured cost floor for single-host clusters
+        # (BASELINE.md).  MOCHI_UDS=1 turns it on for any test/bench.
+        self._owns_uds_dir = False
+        if uds_dir is None and os.environ.get("MOCHI_UDS") == "1":
+            import tempfile
+
+            uds_dir = tempfile.mkdtemp(prefix="mochi-uds-")
+            self._owns_uds_dir = True  # close() removes what WE created
+        self.uds_dir = uds_dir
         self.replicas: List[MochiReplica] = []
         self.keypairs: Dict[str, KeyPair] = {}
         self.config: Optional[ClusterConfig] = None
@@ -66,11 +78,16 @@ class VirtualCluster:
         server_ids = [f"server-{i}" for i in range(self.n_servers)]
         self.keypairs = {sid: generate_keypair() for sid in server_ids}
 
+        def host_for(sid: str) -> str:
+            if self.uds_dir is not None:
+                return f"unix:{os.path.join(self.uds_dir, sid + '.sock')}"
+            return self.host
+
         # Start replicas on ephemeral ports first, then freeze the config with
         # the real ports (replicas share one config object, as the reference's
         # per-server clones share one generated properties set).
         placeholder = ClusterConfig.build(
-            {sid: f"{self.host}:1" for sid in server_ids},
+            {sid: f"{host_for(sid)}:1" for sid in server_ids},
             rf=self.rf,
             public_keys={sid: kp.public_key for sid, kp in self.keypairs.items()},
         )
@@ -82,14 +99,14 @@ class VirtualCluster:
                 verifier=self.verifier_factory() if self.verifier_factory else None,
                 client_public_keys=self.client_keys,
                 require_client_auth=self.require_client_auth,
-                host=self.host,
+                host=host_for(sid),
                 port=0,
                 shed_lag_ms=self.shed_lag_ms,
             )
             await replica.start()
             self.replicas.append(replica)
         self.config = ClusterConfig.build(
-            {r.server_id: f"{self.host}:{r.bound_port}" for r in self.replicas},
+            {r.server_id: f"{host_for(r.server_id)}:{r.bound_port}" for r in self.replicas},
             rf=self.rf,
             public_keys={sid: kp.public_key for sid, kp in self.keypairs.items()},
         )
@@ -124,7 +141,8 @@ class VirtualCluster:
             verifier=self.verifier_factory() if self.verifier_factory else None,
             client_public_keys=self.client_keys,
             require_client_auth=self.require_client_auth,
-            host=self.host,
+            # same endpoint the config advertises (UDS path or TCP host)
+            host=self.config.servers[server_id].host,
             port=port,
         )
         await fresh.start()
@@ -142,6 +160,11 @@ class VirtualCluster:
             await replica.close()
         self.replicas.clear()
         self._clients.clear()
+        if self._owns_uds_dir and self.uds_dir is not None:
+            import shutil
+
+            shutil.rmtree(self.uds_dir, ignore_errors=True)
+            self.uds_dir = None
 
     async def __aenter__(self) -> "VirtualCluster":
         return await self.start()

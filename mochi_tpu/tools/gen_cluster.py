@@ -38,6 +38,14 @@ def main(argv=None) -> None:
     )
     parser.add_argument("--format", choices=("json", "properties"), default="json")
     parser.add_argument(
+        "--uds",
+        action="store_true",
+        help="address servers by Unix-domain socket (<out-dir>/<sid>.sock) "
+        "instead of TCP — single-host clusters skip the loopback TCP/IP "
+        "stack on the kernel send path (mutually exclusive with a "
+        "multi-host --host list)",
+    )
+    parser.add_argument(
         "--with-admin",
         action="store_true",
         help="also generate an admin keypair (admin.seed) and pin its public "
@@ -53,15 +61,33 @@ def main(argv=None) -> None:
     server_ids = [f"server-{i}" for i in range(args.servers)]
     keypairs = {sid: generate_keypair() for sid in server_ids}
     hosts = args.host.split(",")
-    config = ClusterConfig.build(
-        {
+    if args.uds:
+        if args.host != parser.get_default("host"):
+            raise SystemExit(
+                "--uds is single-host via socket paths; drop --host "
+                f"(got {args.host!r})"
+            )
+        paths = {sid: (out / (sid + ".sock")).resolve() for sid in server_ids}
+        too_long = [p for p in paths.values() if len(str(p)) > 100]
+        if too_long:
+            # AF_UNIX sun_path caps at ~108 bytes; failing here beats every
+            # server dying at bind with a raw OSError (code-review r4)
+            raise SystemExit(
+                f"--out-dir too deep for AF_UNIX socket paths (>100 chars): "
+                f"{too_long[0]}"
+            )
+        urls = {sid: f"unix:{p}:0" for sid, p in paths.items()}
+    else:
+        urls = {
             # round-robin across hosts; ports advance only when a host wraps,
             # so every host runs the same well-known port where possible
             sid: f"{hosts[i % len(hosts)]}:{args.base_port + i // len(hosts)}"
             if len(hosts) > 1
             else f"{hosts[0]}:{args.base_port + i}"
             for i, sid in enumerate(server_ids)
-        },
+        }
+    config = ClusterConfig.build(
+        urls,
         rf=args.rf,
         public_keys={sid: kp.public_key for sid, kp in keypairs.items()},
     )
