@@ -82,8 +82,10 @@ class ServerInfo:
         send-path is the measured cost floor on single-host deployments).
         rpartition: a UDS path contains ':' after the scheme."""
         host, _, port = url.rpartition(":")
-        if not host or not port:
-            raise ValueError(f"bad server url (want host:port): {url!r}")
+        if not host or not port.isdigit():
+            raise ValueError(
+                f"bad server url (want host:port, or unix:<path>:0): {url!r}"
+            )
         return cls(server_id=server_id, host=host, port=int(port))
 
     @property

@@ -216,23 +216,48 @@ class RpcServer:
         self.host = host
         self.port = port
         self.handler = handler
+        # single source of the "unix:" scheme logic (code-review r4: the
+        # prefix was sliced inline in three methods)
+        self._unix_path: Optional[str] = (
+            host[len("unix:"):] if host.startswith("unix:") else None
+        )
+        self._bound_ino: Optional[tuple] = None
         self._server: Optional[asyncio.base_events.Server] = None
         self._protocols: set = set()
 
     async def start(self) -> None:
         loop = asyncio.get_running_loop()
-        if self.host.startswith("unix:"):
+        if self._unix_path is not None:
             # Unix-domain socket: same framed protocol, no TCP/IP stack —
             # the kernel loopback send path is the measured cost floor for
-            # single-host clusters (BASELINE.md round-4 note).
-            path = self.host[len("unix:"):]
-            try:
-                os.unlink(path)  # stale socket from a previous run
-            except FileNotFoundError:
-                pass
+            # single-host clusters (BASELINE.md round-4 note).  Only a DEAD
+            # leftover socket is unlinked: stealing a live server's path
+            # would strand it running-but-unreachable, where TCP fails
+            # loudly with EADDRINUSE (code-review r4).
+            import socket as _socket
+
+            path = self._unix_path
+            if os.path.exists(path):
+                probe = _socket.socket(_socket.AF_UNIX)
+                probe.settimeout(0.2)
+                try:
+                    probe.connect(path)
+                    probe.close()
+                    raise OSError(f"unix socket {path} is in use by a live server")
+                except (ConnectionRefusedError, _socket.timeout, FileNotFoundError):
+                    probe.close()
+                    try:
+                        os.unlink(path)  # stale socket from a dead process
+                    except FileNotFoundError:
+                        pass
             self._server = await loop.create_unix_server(
                 lambda: _RpcServerProtocol(self), path
             )
+            try:
+                st = os.stat(path)
+                self._bound_ino = (st.st_dev, st.st_ino)
+            except OSError:
+                self._bound_ino = None
         else:
             self._server = await loop.create_server(
                 lambda: _RpcServerProtocol(self), self.host, self.port
@@ -241,7 +266,7 @@ class RpcServer:
     @property
     def bound_port(self) -> int:
         assert self._server is not None
-        if self.host.startswith("unix:"):
+        if self._unix_path is not None:
             return self.port  # UDS has no port; identity stays the path
         return self._server.sockets[0].getsockname()[1]
 
@@ -255,11 +280,16 @@ class RpcServer:
                     proto.transport.close()
             await self._server.wait_closed()
             self._server = None
-            if self.host.startswith("unix:"):
+            if self._unix_path is not None:
                 # a stale socket file accepts nothing but still looks alive
-                # to path-probing consumers — ENOENT beats ECONNREFUSED
+                # to path-probing consumers — ENOENT beats ECONNREFUSED.
+                # Unlink ONLY our own inode: a newer server may have
+                # (legitimately, after our socket died) bound a fresh
+                # socket at this path (code-review r4).
                 try:
-                    os.unlink(self.host[len("unix:"):])
+                    st = os.stat(self._unix_path)
+                    if (st.st_dev, st.st_ino) == self._bound_ino:
+                        os.unlink(self._unix_path)
                 except OSError:
                     pass
 

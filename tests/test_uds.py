@@ -27,6 +27,46 @@ def test_server_info_unix_url_roundtrip():
     assert not tcp.is_unix and tcp.host == "10.0.0.7" and tcp.port == 8101
 
 
+def test_uds_double_bind_refused_stale_socket_reclaimed():
+    """A second server must NOT steal a live server's socket (the TCP
+    analog fails with EADDRINUSE); a stale socket from a dead process IS
+    reclaimed at bind."""
+    from mochi_tpu.net.transport import RpcServer
+
+    async def body():
+        with tempfile.TemporaryDirectory(prefix="mochi-uds-") as d:
+            path = f"{d}/s.sock"
+
+            async def handler(env):
+                return None
+
+            live = RpcServer(f"unix:{path}", 0, handler)
+            await live.start()
+            try:
+                thief = RpcServer(f"unix:{path}", 0, handler)
+                try:
+                    await thief.start()
+                    raise AssertionError("second bind on a live socket succeeded")
+                except OSError:
+                    pass
+            finally:
+                await live.close()
+            import os
+
+            assert not os.path.exists(path)  # close unlinked our socket
+            # stale socket (no listener): simulate a dead process's leftover
+            import socket as s
+
+            sock = s.socket(s.AF_UNIX)
+            sock.bind(path)
+            sock.close()  # bound but never listening -> connect refused
+            fresh = RpcServer(f"unix:{path}", 0, handler)
+            await fresh.start()  # reclaims the stale path
+            await fresh.close()
+
+    asyncio.run(asyncio.wait_for(body(), timeout=30))
+
+
 def test_cluster_over_uds():
     async def body():
         with tempfile.TemporaryDirectory(prefix="mochi-uds-") as d:
