@@ -5,6 +5,8 @@ JDK MessageDigest intrinsics — SURVEY.md §2.9); the TPU framework keeps its
 Python control plane but moves hot host loops to C extensions:
 
 * ``_mcode`` — the canonical wire/signing codec (mcode.c).
+* ``_hbatch`` — batched SHA-512(R||A||M) mod L, the per-item half of the
+  verifier's host prepare (hbatch.c).
 
 Build model: compiled on first use into this package directory with the
 system compiler (cc/gcc), cached by source mtime; if no toolchain is
@@ -27,13 +29,13 @@ from typing import Optional
 LOG = logging.getLogger(__name__)
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_cached: Optional[ModuleType] = None
-_build_attempted = False
+_cached: dict = {}
+_build_attempted: set = set()
 
 
-def _so_path() -> str:
+def _so_path(name: str) -> str:
     suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
-    return os.path.join(_DIR, f"_mcode{suffix}")
+    return os.path.join(_DIR, f"{name}{suffix}")
 
 
 def _needs_build(so: str, src: str) -> bool:
@@ -52,13 +54,13 @@ def _build(src: str, so: str) -> bool:
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
         if proc.returncode != 0:
-            LOG.warning("native mcode build failed:\n%s", proc.stderr)
+            LOG.warning("native build of %s failed:\n%s", src, proc.stderr)
             os.unlink(tmp)
             return False
         os.replace(tmp, so)  # atomic: concurrent builders race benignly
         return True
     except (OSError, subprocess.SubprocessError) as exc:
-        LOG.warning("native mcode build unavailable: %s", exc)
+        LOG.warning("native build unavailable (%s): %s", src, exc)
         try:
             os.unlink(tmp)
         except OSError:
@@ -66,30 +68,39 @@ def _build(src: str, so: str) -> bool:
         return False
 
 
-def get_mcode() -> Optional[ModuleType]:
-    """The compiled ``_mcode`` module, building it if needed; None if no
-    toolchain (callers then use the pure-Python codec)."""
-    global _cached, _build_attempted
-    if _cached is not None:
-        return _cached
+def _get_native(name: str) -> Optional[ModuleType]:
+    """The compiled ``_<name>`` module, building <name>.c if needed; None
+    if no toolchain (callers then use the pure-Python implementations)."""
+    if name in _cached:
+        return _cached[name]
     if os.environ.get("MOCHI_NO_NATIVE"):
         return None
-    src = os.path.join(_DIR, "mcode.c")
-    so = _so_path()
+    src = os.path.join(_DIR, f"{name[1:]}.c")  # _mcode -> mcode.c
+    so = _so_path(name)
     if _needs_build(so, src):
-        if _build_attempted:
+        if name in _build_attempted:
             return None
-        _build_attempted = True
+        _build_attempted.add(name)
         if not _build(src, so):
             return None
     try:
-        spec = importlib.util.spec_from_file_location("mochi_tpu.native._mcode", so)
+        spec = importlib.util.spec_from_file_location(
+            f"mochi_tpu.native.{name}", so
+        )
         assert spec is not None and spec.loader is not None
         mod = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(mod)
-        sys.modules["mochi_tpu.native._mcode"] = mod
-        _cached = mod
+        sys.modules[f"mochi_tpu.native.{name}"] = mod
+        _cached[name] = mod
         return mod
     except Exception:
-        LOG.exception("native mcode failed to load; using pure-Python codec")
+        LOG.exception("native %s failed to load; using pure-Python path", name)
         return None
+
+
+def get_mcode() -> Optional[ModuleType]:
+    return _get_native("_mcode")
+
+
+def get_hbatch() -> Optional[ModuleType]:
+    return _get_native("_hbatch")

@@ -1,0 +1,110 @@
+"""Native batched-h (native/hbatch.c): differential contract vs hashlib +
+python bignum, including SHA-512 block boundaries and mod-L edge values.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import random
+
+import numpy as np
+import pytest
+
+from mochi_tpu.crypto import batch_verify, keys
+from mochi_tpu.crypto import field as F
+from mochi_tpu.native import get_hbatch
+from mochi_tpu.verifier.spi import VerifyItem
+
+hb = get_hbatch()
+pytestmark = pytest.mark.skipif(hb is None, reason="no native toolchain")
+
+
+def test_sha512_matches_hashlib_across_block_boundaries():
+    rng = random.Random(42)
+    # lengths around the 128-byte block and 112-byte padding boundaries,
+    # plus a multi-block tail
+    lengths = sorted({0, 1, 63, 64, 110, 111, 112, 113, 127, 128, 129,
+                      239, 240, 255, 256, 1000, 5000})
+    for n in lengths:
+        data = bytes(rng.randrange(256) for _ in range(n))
+        assert hb.sha512(data) == hashlib.sha512(data).digest(), n
+
+
+def test_reduce512_matches_python_mod():
+    L = F.L_INT
+    for probe in (0, 1, L - 1, L, L + 1, 2 * L, 3 * L - 1,
+                  (1 << 252) - 1, (1 << 256) - 1, (1 << 512) - 1):
+        d = probe.to_bytes(64, "little")
+        assert int.from_bytes(hb.reduce512(d), "little") == probe % L, probe
+    for _ in range(500):
+        d = os.urandom(64)
+        assert (
+            int.from_bytes(hb.reduce512(d), "little")
+            == int.from_bytes(d, "little") % L
+        )
+
+
+def test_h_batch_matches_per_item_hashlib():
+    rng = random.Random(7)
+    n = 64
+    rs = os.urandom(32 * n)
+    as_ = os.urandom(32 * n)
+    msgs = [bytes(rng.randrange(256) for _ in range(rng.choice([0, 5, 64, 111, 128, 300])))
+            for _ in range(n)]
+    lens = np.asarray([len(m) for m in msgs], dtype=np.uint64)
+    out = hb.h_batch(rs, as_, b"".join(msgs), lens.tobytes())
+    for i in range(n):
+        expect = (
+            int.from_bytes(
+                hashlib.sha512(
+                    rs[32 * i : 32 * i + 32] + as_[32 * i : 32 * i + 32] + msgs[i]
+                ).digest(),
+                "little",
+            )
+            % F.L_INT
+        )
+        got = int.from_bytes(out[32 * i : 32 * i + 32], "little")
+        assert got == expect, i
+
+
+def test_h_batch_rejects_inconsistent_buffers():
+    with pytest.raises(ValueError):
+        hb.h_batch(b"\x00" * 32, b"\x00" * 32, b"", (5).to_bytes(8, "little"))
+    with pytest.raises(ValueError):
+        hb.h_batch(b"\x00" * 31, b"\x00" * 32, b"", (0).to_bytes(8, "little"))
+    # uint64 wraparound: two 2^63 lengths sum to 0 == len(b"") — must be
+    # rejected, not read out of bounds (code-review r4)
+    wrap = (1 << 63).to_bytes(8, "little") * 2
+    with pytest.raises(ValueError):
+        hb.h_batch(b"\x00" * 64, b"\x00" * 64, b"", wrap)
+
+
+def test_prepare_packed_native_equals_pure_python():
+    kp = keys.generate_keypair()
+    other = keys.generate_keypair()
+    items = []
+    for i in range(40):
+        msg = b"np-%d" % i * (i % 7)
+        sig = kp.sign(msg)
+        if i % 9 == 4:
+            sig = sig[:63]  # malformed length
+        elif i % 9 == 7:
+            sig = other.sign(msg)  # wrong key (valid encoding)
+        items.append(VerifyItem(kp.public_key, msg, sig))
+    native = batch_verify.prepare_packed(items)
+    os.environ["MOCHI_NO_NATIVE"] = "1"
+    try:
+        import mochi_tpu.native as N
+
+        N._cached.clear()
+        pure = batch_verify.prepare_packed(items)
+    finally:
+        del os.environ["MOCHI_NO_NATIVE"]
+        N._cached.clear()
+    for a, b in zip(native, pure):
+        np.testing.assert_array_equal(a, b)
+    # end-to-end verdicts through the native prepare
+    got = batch_verify.verify_batch(items)
+    expect = [keys.verify(it.public_key, it.message, it.signature) for it in items]
+    assert got == expect
