@@ -85,6 +85,29 @@ def main() -> None:
         assert all(out)
     e2e = max(e2e_rates)
 
+    # Comb leg: the registered-signer end-to-end (the cluster's production
+    # posture — host prepare + comb device path through the same chunked
+    # pipeline).  Faster device -> the host/pipeline overhead matters MORE
+    # here; the native batched-h prepare (native/hbatch.c) is what keeps
+    # the host ahead.
+    from mochi_tpu.crypto import comb as comb_mod
+
+    reg = comb_mod.SignerRegistry(device=dev)
+    if reg.register(kp.public_key) is None:
+        raise RuntimeError("signer registration failed")
+    t0 = time.perf_counter()
+    out = batch_verify.verify_batch(items, registry=reg)  # compile + warm
+    assert all(out)
+    comb_warm_s = time.perf_counter() - t0
+    comb_rates = []
+    for _ in range(2):
+        t0 = time.perf_counter()
+        out = batch_verify.verify_batch(items, registry=reg)
+        comb_rates.append(n / (time.perf_counter() - t0))
+        assert all(out)
+    e2e_comb = max(comb_rates)
+    print(f"comb e2e warm {comb_warm_s:.1f}s; {e2e_comb:.1f} sigs/s")
+
     rec = {
         "metric": "e2e_vs_pipelined",
         "platform": dev.platform,
@@ -94,6 +117,8 @@ def main() -> None:
         "pipelined_sigs_per_sec": round(pipelined, 1),
         "e2e_sigs_per_sec": round(e2e, 1),
         "e2e_fraction_of_pipelined": round(e2e / pipelined, 3),
+        "e2e_comb_sigs_per_sec": round(e2e_comb, 1),
+        "e2e_comb_vs_ladder_e2e": round(e2e_comb / e2e, 2),
         "phase_per_chunk_ms": {
             "prepare": round(prep_s * 1e3, 1),
             "dispatch": round(dispatch_s * 1e3, 1),
