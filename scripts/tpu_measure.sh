@@ -169,6 +169,15 @@ EOF
 fi
 commit_artifacts "TPU measurement battery r${ROUND}: headline bench"
 
+echo "== 3f. known-signer comb vs ladder (crypto/comb.py, cluster-shaped traffic)" | tee -a "$OUT"
+# Runs FIRST among the sweeps: the comb path is the round's new headline
+# lever (built after the 03:16Z window) and must not queue behind the
+# re-measurement legs if the next window is short.
+run_step comb 1500 device python scripts/comb_bench.py
+
+echo "== 3d. end-to-end vs pipelined on 64k items (goal >=90%; incl. comb leg)" | tee -a "$OUT"
+run_step e2e 1500 device python scripts/e2e_bench.py 65536
+
 echo "== 3. MAX_BUCKET sweep (8192 was the round-2 peak; check 16384 post-packing)" | tee -a "$OUT"
 # throughput_probe.py is the shared body of 3 and 3b (it refuses CPU
 # fallbacks so a dead-tunnel run can never be banked as TPU evidence).
@@ -194,14 +203,9 @@ step_rc ab_report "${PIPESTATUS[0]}" host
 echo "== 3c. cycle decomposition (roofline evidence for the MFU story)" | tee -a "$OUT"
 run_step roofline 1200 device python scripts/roofline.py 8192
 
-echo "== 3d. end-to-end vs pipelined on 64k items (goal >=90%)" | tee -a "$OUT"
-run_step e2e 1200 device python scripts/e2e_bench.py 65536
-
 echo "== 3e. forged-fraction throughput sweep (no-cliff proof)" | tee -a "$OUT"
 run_step forgery 900 device python scripts/forgery_bench.py 8192
 
-echo "== 3f. known-signer comb vs ladder (crypto/comb.py, cluster-shaped traffic)" | tee -a "$OUT"
-run_step comb 1500 device python scripts/comb_bench.py
 # Merge the structured e2e/forgery records into the round's results file
 # (the log is committed too, but the JSON file is what the judge greps).
 # Scoped to this attempt's section; earlier attempts' records were merged
