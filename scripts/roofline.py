@@ -167,6 +167,46 @@ def main() -> None:
         f"({'schedule/fusion-bound: the composed graph is slower than its parts' if ratio > 1.5 else 'parts-bound: attack the biggest row above'})"
     )
 
+    # ---- comb decomposition (crypto/comb.py) ----------------------------
+    # Per comb iteration: 1x signer-row slice (of the upfront gather), 2x
+    # madd, 1x select_b — no doublings.  The gather is timed whole (64
+    # windows at once, as the kernel issues it) then amortized per window.
+    from mochi_tpu.crypto import comb as comb_mod
+
+    reg = comb_mod.SignerRegistry()
+    if reg.register(kp.public_key) is None:
+        raise RuntimeError("registration failed")
+    table = reg.device_table(dev)
+    kidx = jnp.zeros((B,), jnp.int32)
+    hmag = jnp.asarray(rng.integers(0, 9, (64, B), dtype=np.int32))
+
+    def gather_bench(acc, i):
+        win = jnp.arange(comb_mod.N_WINDOWS, dtype=jnp.int32)[:, None]
+        # thread the carry into the indices so the gather stays live
+        fi = (kidx + acc[0, :1].astype(jnp.int32))[None, :] * (
+            comb_mod.N_WINDOWS * comb_mod.N_ENTRIES
+        ) + win * comb_mod.N_ENTRIES + hmag
+        rows = jnp.take(table, fi, axis=0, mode="clip")
+        return acc + rows.sum(axis=0).T.astype(jnp.int32)[: F.NLIMBS], i
+
+    t_gather = timed(gather_bench, a, idx, reps_lo=10, reps_hi=60)
+    print(f"\ncomb upfront gather (64 windows): {t_gather*1e6:.2f} us "
+          f"({t_gather*1e6/64:.2f} us/window)")
+    est_comb = 64 * (
+        2 * parts["madd_niels"] + parts["select_b(9x3)"]
+    ) + t_gather
+    print(f"sum-of-parts comb estimate: {est_comb*1e3:.2f} ms "
+          f"(+ decompress, shared with the ladder)")
+    t_comb = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = batch_verify.verify_batch(items, registry=reg)
+        t_comb = min(t_comb, time.perf_counter() - t0)
+    assert all(out)
+    print(f"measured full comb verify:  {t_comb*1e3:.2f} ms  ({B/t_comb:.0f} sigs/s)")
+    cratio = t_comb / est_comb if est_comb else float("nan")
+    print(f"comb full/parts ratio: {cratio:.2f}")
+
 
 if __name__ == "__main__":
     main()
