@@ -105,6 +105,46 @@ def main() -> None:
             "speedup_vs_ladder": round(rate / ladder_rate, 3),
         }
 
+    # ---- accumulation-formulation A/B at the kernel level ---------------
+    # chain (default): 128 sequential madds, fewest muls.  tree: one-hot
+    # MXU select + 7-level balanced reduction — ~40% more muls, ~18x
+    # shallower critical path.  Decides MOCHI_COMB_IMPL for the regime the
+    # chip actually is in (the roofline keeps saying schedule-bound).
+    reg = comb.SignerRegistry()
+    reg.register_all([kp.public_key for kp in kps[: signer_counts[0]]])
+    items = _items(kps[: signer_counts[0]], n)
+    key_idx = np.asarray(
+        [reg.index_of(it.public_key) for it in items], dtype=np.int32
+    )
+    (ckey, y_r, sign_r, s_sc, h_sc), pre_ok = comb._prepare_comb(items, key_idx, None)
+    assert pre_ok.all()
+    table = reg.device_table()
+    impl_rates = {}
+    for impl in ("chain", "tree"):
+        t0 = time.perf_counter()
+        out = np.asarray(
+            comb._verify_comb_jit(table, ckey, y_r, sign_r, s_sc, h_sc, impl=impl)
+        )
+        assert out.all()
+        compile_s = time.perf_counter() - t0
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            np.asarray(
+                comb._verify_comb_jit(
+                    table, ckey, y_r, sign_r, s_sc, h_sc, impl=impl
+                )
+            )
+            best = min(best, time.perf_counter() - t0)
+        impl_rates[impl] = round(n / best, 1)
+        print(
+            f"COMB_IMPL={impl}: {n / best:.1f} sigs/s "
+            f"({best * 1e3:.1f} ms, compile {compile_s:.1f}s)",
+            flush=True,
+        )
+    results["impl_ab"] = impl_rates
+    results["impl_winner"] = max(impl_rates, key=impl_rates.get)
+
     # correctness spot check on-device: forgeries must still be caught
     bad = items[:64]
     bad = [

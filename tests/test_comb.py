@@ -353,6 +353,30 @@ def test_cluster_protocol_over_comb_verifier():
     assert any(b._ready_comb for b in backends)
 
 
+def test_tree_impl_matches_chain_and_openssl(signers, registry):
+    """The tree accumulation (MOCHI_COMB_IMPL=tree: one-hot MXU select +
+    balanced reduction) must produce bit-identical verdicts to the chain
+    form and OpenSSL on the adversarial mix."""
+    items = _mixed_items(signers, n=32)
+    expect = _expected(items)
+    key_idx = np.asarray(
+        [registry.index_of(it.public_key) for it in items], np.int32
+    )
+    (ckey, y_r, sign_r, s_sc, h_sc), pre_ok = comb._prepare_comb(
+        items, key_idx, None
+    )
+    table = registry.device_table()
+    chain = np.asarray(
+        comb._verify_comb_jit(table, ckey, y_r, sign_r, s_sc, h_sc, impl="chain")
+    )
+    tree = np.asarray(
+        comb._verify_comb_jit(table, ckey, y_r, sign_r, s_sc, h_sc, impl="tree")
+    )
+    np.testing.assert_array_equal(chain, tree)
+    got = [bool(b) for b in np.logical_and(tree[: len(items)], pre_ok)]
+    assert got == expect
+
+
 def test_comb_table_math_against_host_ints(signers):
     """The device comb table rows really are [d*16^w](-A) in Niels form:
     rebuild one entry from host ints and compare limbs."""
