@@ -140,6 +140,42 @@ def _demo_main(argv: Optional[Sequence[str]] = None) -> None:
     local_bitmap = np.concatenate(
         [np.asarray(s.data) for s in bitmap.addressable_shards]
     )
+
+    # ---- comb leg across the process boundary ---------------------------
+    # The registered-signer fast path (crypto/comb.py) on the SAME global
+    # mesh: the signer set is cluster config — identical on every host —
+    # so each host builds the same table and replicates it to its local
+    # devices (no cross-host transfer; DCN carries nothing).  Keys here:
+    # a fixed seed so both processes derive the identical registry.
+    from ..crypto import comb as comb_mod
+    from .sharded import make_sharded_verify_comb
+
+    ckp = keys.keypair_from_seed(bytes([7]) * 32)
+    citems = []
+    for i in range(lanes):
+        msg = b"comb-lane-%d-%d" % (args.process_id, i)
+        sig = ckp.sign(msg)
+        if i % 4 == 3:
+            sig = bytes([sig[0] ^ 1]) + sig[1:]
+        citems.append(VerifyItem(ckp.public_key, msg, sig))
+    reg = comb_mod.SignerRegistry()
+    if reg.register(ckp.public_key) is None:
+        raise RuntimeError("registration failed")
+    _, _, cy_r, csign_r, cs_sc, ch_sc, cpre_ok = batch_verify.prepare_packed(citems)
+    assert cpre_ok.all()
+    key_idx = np.zeros(lanes, dtype=np.int32)
+    rep = NamedSharding(mesh, P())
+    table_np = np.asarray(reg.device_table())
+    table_g = jax.make_array_from_process_local_data(rep, table_np)
+    cg = host_local_to_global(mesh, (key_idx, cy_r, csign_r, cs_sc, ch_sc))
+    comb_fn = make_sharded_verify_comb(mesh)
+    cbitmap = comb_fn(table_g, *cg)
+    comb_local = np.concatenate(
+        [np.asarray(s.data) for s in cbitmap.addressable_shards]
+    )
+    expect_local = np.asarray([i % 4 != 3 for i in range(lanes)])
+    assert (comb_local == expect_local).all(), (comb_local, expect_local)
+
     print(
         json.dumps(
             {
@@ -150,6 +186,7 @@ def _demo_main(argv: Optional[Sequence[str]] = None) -> None:
                 "counts": counts.tolist(),
                 "committed": committed.tolist(),
                 "local_valid": int(local_bitmap.sum()),
+                "comb_local_valid": int(comb_local.sum()),
             }
         )
     )
