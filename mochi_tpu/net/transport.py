@@ -275,10 +275,26 @@ class RpcServer:
             self._server.close()
             # Drop live connections first: Server.wait_closed() waits for
             # every connection to finish, and peers hold theirs open.
+            # abort(), not close(): a graceful close waits to flush, and a
+            # connection whose peer has stopped reading (e.g. another
+            # replica's cancelled resync worker mid-state-transfer after a
+            # reconfiguration) can keep the flush — and therefore
+            # wait_closed() and the whole replica shutdown — pending
+            # forever.  Shutdown wants connections DROPPED.
             for proto in list(self._protocols):
                 if proto.transport is not None:
-                    proto.transport.close()
-            await self._server.wait_closed()
+                    proto.transport.abort()
+            # Belt-and-braces: a connection accepted between the snapshot
+            # above and wait_closed() would hang us the same way, so sweep
+            # until the server reports fully closed.
+            while True:
+                try:
+                    await asyncio.wait_for(self._server.wait_closed(), timeout=1.0)
+                    break
+                except asyncio.TimeoutError:
+                    for proto in list(self._protocols):
+                        if proto.transport is not None:
+                            proto.transport.abort()
             self._server = None
             if self._unix_path is not None:
                 # a stale socket file accepts nothing but still looks alive

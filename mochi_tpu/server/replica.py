@@ -289,6 +289,21 @@ class MochiReplica:
             new_cfg.configstamp, old.configstamp, added, removed,
         )
         self.metrics.mark("replica.config-installs")
+        # New member identities join the verifier's known-signer registry
+        # (comb fast path, crypto/comb.py).  Without this their grant
+        # certificates still verify — just on the general ladder — so the
+        # call is best-effort by design.
+        if added and hasattr(self.verifier, "register_signers"):
+            try:
+                self.verifier.register_signers(
+                    [
+                        new_cfg.public_keys[sid]
+                        for sid in added
+                        if sid in new_cfg.public_keys
+                    ]
+                )
+            except Exception:
+                LOG.exception("signer registration after reconfig failed")
         if self.server_id not in new_cfg.servers:
             LOG.warning(
                 "this server is not a member of config cs=%d — retired "

@@ -129,6 +129,65 @@ def test_add_server_live():
     run(main())
 
 
+def test_reconfig_registers_new_identity_with_verifier():
+    """Adding a server live also registers its identity with a
+    comb-capable verifier (crypto/comb.py) — new-member certificates take
+    the fast path instead of silently staying on the general ladder."""
+
+    class RecordingVerifier:
+        def __init__(self):
+            self.registered = []
+
+        async def verify_batch(self, items):
+            from mochi_tpu.crypto import keys as _k
+
+            return [
+                _k.verify(it.public_key, it.message, it.signature)
+                for it in items
+            ]
+
+        def register_signers(self, pubs):
+            self.registered.extend(bytes(p) for p in pubs)
+
+        async def close(self):
+            pass
+
+    verifiers = []
+
+    def factory():
+        v = RecordingVerifier()
+        verifiers.append(v)
+        return v
+
+    async def main():
+        async with VirtualCluster(4, rf=4, verifier_factory=factory) as vc:
+            client = vc.client()
+            kp5 = generate_keypair()
+            servers = current_servers(vc)
+            new_replica = MochiReplica(
+                server_id="server-4",
+                config=vc.config,
+                keypair=kp5,
+                client_public_keys=vc.client_keys,
+                host=vc.host,
+                port=0,
+            )
+            await new_replica.start()
+            servers["server-4"] = f"{vc.host}:{new_replica.bound_port}"
+            new_cfg = vc.config.evolve(
+                servers, public_keys={"server-4": kp5.public_key}
+            )
+            new_replica.config = new_cfg
+            new_replica.store.config = new_cfg
+            vc.replicas.append(new_replica)
+            vc.keypairs["server-4"] = kp5
+            await client.reconfigure_cluster(new_cfg)
+            for v in verifiers:
+                assert kp5.public_key in v.registered
+
+    run(main())
+
+
 def test_remove_server_live():
     async def main():
         async with VirtualCluster(5, rf=4) as vc:
