@@ -403,6 +403,19 @@ def verifier_stats(verifier) -> dict:
         v = getattr(verifier, attr, None)
         if isinstance(v, int):
             st[attr] = v
+    backend = getattr(verifier, "backend", None)
+    registry = getattr(backend, "registry", None)
+    if registry is not None:
+        # comb fast-path observability (crypto/comb.py): is the registry
+        # populated, which buckets have a compiled comb program, and is
+        # the path actually carrying traffic
+        from ..crypto.comb import comb_dispatch_count
+
+        st["comb"] = {
+            "registered_signers": len(registry),
+            "ready_buckets": sorted(getattr(backend, "_ready_comb", {})),
+            "device_dispatches_process_total": comb_dispatch_count(),
+        }
     inner = getattr(verifier, "inner", None)
     if inner is not None:
         st["inner"] = verifier_stats(inner)
