@@ -43,6 +43,14 @@ This module is pure compute + registry; routing lives in
 :func:`mochi_tpu.crypto.batch_verify.verify_batch` (``registry=`` arg) so
 callers keep one entry point.  The reference has no counterpart for any of
 this (it never signs — ``MochiProtocol.proto:123``).
+
+Considered and not built: 5-bit windows (51 windows x 17 entries).  They
+cut the madd count ~20% (128 -> 102) but the basepoint masked-select grows
+from 9x64 to 17x51 terms (+50% select work), tables grow 1.5x, and the
+signed recode needs base-32 carries — net model estimate <10% either way,
+so the A/B budget went to the chain-vs-tree formulation instead
+(``COMB_IMPL``), which attacks the dependency DEPTH the roofline keeps
+flagging rather than the op count.
 """
 
 from __future__ import annotations
