@@ -247,6 +247,9 @@ def _measure() -> dict:
                 "best_sigs_per_sec": round(comb_best, 1),
                 "speedup_vs_ladder": round(comb_best / best_rate, 2),
                 "compile_s": round(comb_compile_s, 1),
+                # single signer = best-case gather locality; the K=16/64
+                # cluster-shaped sweep is scripts/comb_bench.py (battery 3f)
+                "registered_signers": 1,
                 "posture": "registered-signer (cluster cert traffic)",
             }
         except Exception as exc:  # never let the extra leg break the headline
