@@ -10,8 +10,11 @@ doublings with its window additions, and those doublings are both the FLOP
 majority (~2048 of ~3600 field muls/signature) and the dependency chain
 that keeps the VPU pipeline shallow.
 
-For a REGISTERED key the doublings can be precomputed away entirely.  On
-registration the host computes, once per signer, the Niels-form table
+For a REGISTERED key the doublings can be precomputed away entirely —
+the classic fixed-base windowing idea (Lim-Lee comb / Pippenger
+precomputation, as in ed25519 ref10's basepoint tables), applied here to
+the SIGNER set rather than the curve basepoint.  On registration the
+host computes, once per signer, the Niels-form table
 
     T[w][d] = [d * 16^w](-A)      w in 0..63, d in 0..8
 
