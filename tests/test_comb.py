@@ -377,6 +377,24 @@ def test_tree_impl_matches_chain_and_openssl(signers, registry):
     assert got == expect
 
 
+def test_comb_chunked_pipeline_path(monkeypatch, signers, registry):
+    """Oversized comb batches chunk at MAX_BUCKET behind the bounded
+    launch window (verify_stream's pipelined path) — shrunk via
+    monkeypatch so the CPU test exercises the real chunk/prepare-thread
+    machinery without 8192-lane compiles."""
+    monkeypatch.setattr(batch_verify, "MAX_BUCKET", 32)
+    kp = signers[0]
+    items = []
+    for i in range(5 * 32 + 7):  # 5 full chunks + a ragged tail
+        msg = b"chunk-%d" % i
+        sig = kp.sign(msg)
+        if i % 11 == 3:
+            sig = sig[:8] + bytes([sig[8] ^ 2]) + sig[9:]
+        items.append(VerifyItem(kp.public_key, msg, sig))
+    expect = _expected(items)
+    assert batch_verify.verify_batch(items, registry=registry) == expect
+
+
 def test_comb_randomized_mutation_fuzz(signers, registry):
     """Batched randomized differential fuzz: random byte flips at random
     positions in signature/pubkey/message, random message lengths, random
