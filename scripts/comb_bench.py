@@ -145,6 +145,49 @@ def main() -> None:
     results["impl_ab"] = impl_rates
     results["impl_winner"] = max(impl_rates, key=impl_rates.get)
 
+    # ---- comb bucket sweep ----------------------------------------------
+    # The ladder's 8192-lane peak was set by the PER-ITEM small-multiples
+    # table spilling VMEM; the comb kernel keeps tables shared (HBM
+    # gathers), so larger buckets may amortize further.  Sweep upward
+    # until the rate drops.
+    sweep = {}
+    best_rate_so_far = 0.0
+    for bucket in (n, 2 * n, 4 * n):  # n=8192 on chip -> 8192/16384/32768
+        try:
+            bitems = _items(kps[: signer_counts[0]], bucket)
+            bkey = np.asarray(
+                [reg.index_of(it.public_key) for it in bitems], dtype=np.int32
+            )
+            (k2, y2, s2, sb2, hb2), ok2 = comb._prepare_comb(bitems, bkey, None)
+            assert ok2.all()
+            t0 = time.perf_counter()
+            out = np.asarray(
+                comb._verify_comb_jit(table, k2, y2, s2, sb2, hb2)
+            )
+            compile_s = time.perf_counter() - t0
+            assert out.all()
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                np.asarray(comb._verify_comb_jit(table, k2, y2, s2, sb2, hb2))
+                best = min(best, time.perf_counter() - t0)
+            rate = bucket / best
+            sweep[str(bucket)] = round(rate, 1)
+            print(
+                f"COMB_BUCKET={bucket}: {rate:.1f} sigs/s "
+                f"({best * 1e3:.1f} ms, compile {compile_s:.1f}s)",
+                flush=True,
+            )
+            if rate < best_rate_so_far * 0.95:
+                break  # regressing: stop burning chip time
+            best_rate_so_far = max(best_rate_so_far, rate)
+        except Exception as exc:  # OOM at a big shape must not kill the step
+            sweep[str(bucket)] = f"error: {type(exc).__name__}"
+            print(f"COMB_BUCKET={bucket}: {sweep[str(bucket)]}", flush=True)
+            break
+    if sweep:
+        results["bucket_sweep"] = sweep
+
     # correctness spot check on-device: forgeries must still be caught
     bad = items[:64]
     bad = [
