@@ -119,8 +119,23 @@ async def amain(args) -> None:
         logging.info("admin shell on port %s", admin.bound_port)
     logging.info("replica %s serving on %s:%s", args.server_id, replica.rpc.host, replica.bound_port)
     print(f"READY {args.server_id} {replica.bound_port}", flush=True)
+    # Graceful SIGTERM/SIGINT: run the real close path — final snapshot
+    # (state is in-memory; the snapshot IS the durability), peer/RPC
+    # teardown, and the UDS socket unlink.  Without this a supervisor's
+    # TERM loses the last snapshot interval and leaves stale .sock files
+    # (reclaimed at next bind, but ENOENT beats ECONNREFUSED for probes).
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    import signal as _signal
+
+    for sig in (_signal.SIGTERM, _signal.SIGINT):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except (NotImplementedError, RuntimeError):
+            pass  # non-unix / nested-loop environments
     try:
-        await asyncio.Event().wait()
+        await stop.wait()
+        logging.info("shutdown signal received; closing %s", args.server_id)
     finally:
         if admin is not None:
             await admin.close()
