@@ -81,6 +81,16 @@ def main() -> None:
         "comb_by_signers": {},
     }
 
+    def checkpoint():
+        # Cumulative record after EVERY milestone: the battery merges the
+        # LAST COMB_JSON line in the attempt, so a tunnel death mid-run
+        # still banks everything measured so far.
+        import json as _json
+
+        print("COMB_JSON " + _json.dumps(results), flush=True)
+
+    checkpoint()
+
     for k in signer_counts:
         reg = comb.SignerRegistry()
         reg.register_all([kp.public_key for kp in kps[:k]])
@@ -104,6 +114,7 @@ def main() -> None:
             "sigs_per_sec": round(rate, 1),
             "speedup_vs_ladder": round(rate / ladder_rate, 3),
         }
+        checkpoint()
 
     # ---- accumulation-formulation A/B at the kernel level ---------------
     # chain (default): 128 sequential madds, fewest muls.  tree: one-hot
@@ -144,6 +155,7 @@ def main() -> None:
         )
     results["impl_ab"] = impl_rates
     results["impl_winner"] = max(impl_rates, key=impl_rates.get)
+    checkpoint()
 
     # ---- comb bucket sweep ----------------------------------------------
     # The ladder's 8192-lane peak was set by the PER-ITEM small-multiples
@@ -187,6 +199,7 @@ def main() -> None:
             break
     if sweep:
         results["bucket_sweep"] = sweep
+        checkpoint()
 
     # correctness spot check on-device: forgeries must still be caught
     bad = items[:64]
@@ -200,10 +213,8 @@ def main() -> None:
         batch_verify.verify_batch(bad, registry=reg)
     ), "comb accepted forged signatures"
     print("forgery spot-check OK", flush=True)
-
-    import json
-
-    print("COMB_JSON " + json.dumps(results), flush=True)
+    results["forgery_spot_check"] = "ok"
+    checkpoint()
 
 
 if __name__ == "__main__":
