@@ -85,6 +85,27 @@ def main() -> None:
         assert all(out)
     e2e = max(e2e_rates)
 
+    # Checkpoint the core record BEFORE the comb leg: a tunnel death in
+    # the comb compiles must not lose the ladder e2e measurement (the
+    # battery merges the LAST E2E_JSON line in the attempt).
+    partial = {
+        "metric": "e2e_vs_pipelined",
+        "platform": dev.platform,
+        "n_items": n,
+        "max_bucket": mb,
+        "depth": depth,
+        "pipelined_sigs_per_sec": round(pipelined, 1),
+        "e2e_sigs_per_sec": round(e2e, 1),
+        "e2e_fraction_of_pipelined": round(e2e / pipelined, 3),
+        "phase_per_chunk_ms": {
+            "prepare": round(prep_s * 1e3, 1),
+            "dispatch": round(dispatch_s * 1e3, 1),
+            "first_readback_incl_compile": round(first_readback_s * 1e3, 1),
+        },
+        "goal": ">=0.90 of pipelined (VERDICT r3 item 4)",
+    }
+    print("E2E_JSON " + json.dumps(partial), flush=True)
+
     # Comb leg: the registered-signer end-to-end (the cluster's production
     # posture — host prepare + comb device path through the same chunked
     # pipeline).  Faster device -> the host/pipeline overhead matters MORE
