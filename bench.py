@@ -45,8 +45,46 @@ _CACHE_DIR = os.path.join(_REPO, ".jax_cache")
 # the conservative single-issue figure 1.8e12 (so reported MFU is an upper
 # bound on how much headroom remains, not a flattering lower one).  The
 # Ed25519 verifier is pure int32 VPU work — the MXU plays no part — so VPU
-# peak is the right denominator.
+# peak is the right denominator.  FALLBACK ONLY: when the battery's
+# scripts/vpu_peak.py has measured the actual device (benchmarks/
+# vpu_peak.json), that number replaces this folklore figure (VERDICT r4 #3).
 VPU_PEAK_INT_OPS = 1.8e12
+
+
+def _measured_vpu_peak():
+    """(peak, source) — the battery-measured device peak when available."""
+    try:
+        with open(os.path.join(_REPO, "benchmarks", "vpu_peak.json")) as fh:
+            doc = json.load(fh)
+        if doc.get("platform") == "tpu" and doc.get("value", 0) > 0:
+            return float(doc["value"]), "measured (benchmarks/vpu_peak.json)"
+    except Exception:
+        pass
+    return VPU_PEAK_INT_OPS, "assumed (v5e datasheet figure; never measured)"
+
+
+def _tunnel_rtt_ms(dev) -> float:
+    """Median tiny-op device round trip, ms — the dispatch+relay floor.
+
+    Pins the r02->r04 headline-delta question (VERDICT r4 #5): sequential
+    rates divide by (exec + this RTT), so a fatter tunnel alone moves the
+    headline between rounds with no code change.
+    """
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    x = jax.device_put(jnp.zeros((8,), jnp.int32), dev)
+    f = jax.jit(lambda v: v + 1)
+    np.asarray(f(x))  # compile outside the timed region
+    times = []
+    for _ in range(21):
+        t0 = time.perf_counter()
+        np.asarray(f(x))
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    return round(times[len(times) // 2] * 1e3, 3)
 
 
 def _measure() -> dict:
@@ -266,9 +304,17 @@ def _measure() -> dict:
     ncores = os.cpu_count() or 1
     cpu_allcores = _allcores_baseline(sample, ncores)
 
+    vpu_peak, vpu_peak_source = _measured_vpu_peak()
     mfu = None
     if flops_per_sig:
-        mfu = best_rate * flops_per_sig / VPU_PEAK_INT_OPS
+        mfu = best_rate * flops_per_sig / vpu_peak
+
+    rtt_ms = None
+    if dev.platform == "tpu":
+        try:
+            rtt_ms = _tunnel_rtt_ms(dev)
+        except Exception:
+            pass
 
     return {
         "metric": "ed25519_batch_verify_throughput",
@@ -287,7 +333,9 @@ def _measure() -> dict:
         "cpu_cores": ncores,
         "ops_per_sig_xla_cost_analysis": round(flops_per_sig or 0.0),
         "mfu_vs_vpu_peak": round(mfu, 4) if mfu is not None else None,
-        "vpu_peak_int_ops_assumed": VPU_PEAK_INT_OPS,
+        "vpu_peak_int_ops": vpu_peak,
+        "vpu_peak_source": vpu_peak_source,
+        "tunnel_rtt_ms": rtt_ms,
     }
 
 
@@ -318,9 +366,16 @@ def _verify_chunk(payload):
 def _child() -> None:
     import jax
 
+    cache_dir = _CACHE_DIR
     if os.environ.get("MOCHI_BENCH_FORCE_CPU") == "1":
         jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
+        # CPU backend: host-fingerprint-keyed cache — a shared cache dir
+        # from another machine can feed SIGILL-prone AOT code (VERDICT r4
+        # item 6; same guard as __graft_entry__._dryrun_child)
+        from mochi_tpu.utils.runtime import host_cache_dir
+
+        cache_dir = host_cache_dir(_CACHE_DIR)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
     # Liveness marker: backend init is where a wedged TPU plugin hangs
     # (round-1 failure mode).  The parent gives init a short deadline and
@@ -413,25 +468,39 @@ def main() -> None:
     # window.
     try:
         import glob
+        import re
 
-        best = None
-        best_src = None
+        candidates = []
         for path in sorted(glob.glob(os.path.join(_REPO, "benchmarks", "results_r*_tpu.json"))):
             try:
                 with open(path) as fh:
                     live = json.load(fh).get("headline", {})
             except Exception:
                 continue
-            if live.get("platform") == "tpu" and (
-                best is None or live.get("value", 0) > best.get("value", 0)
-            ):
-                best, best_src = live, path
-        if best is not None:
-            result["last_live_tpu_capture"] = {
-                "sigs_per_sec": best.get("value"),
-                "vs_baseline": best.get("vs_baseline"),
-                "source": f"{os.path.relpath(best_src, _REPO)} (committed live capture)",
-            }
+            if live.get("platform") != "tpu":
+                continue
+            m = re.search(r"results_r(\w+)_tpu", path)
+            candidates.append({
+                "sigs_per_sec": live.get("value"),
+                "vs_baseline": live.get("vs_baseline"),
+                "round": m.group(1) if m else "?",
+                # battery-produced captures carry witnessed=true (the
+                # watchdog log corroborates the live window); older
+                # records without the flag are builder-committed only
+                "witnessed": bool(live.get("witnessed")),
+                "source": f"{os.path.relpath(path, _REPO)} (committed live capture)",
+            })
+        if candidates:
+            # Prefer witnessed captures over raw max-value (VERDICT r4
+            # weak #1): the pointer the driver sees should be the best
+            # *corroborated* number, with the overall max alongside.
+            witnessed = [c for c in candidates if c["witnessed"]]
+            pool = witnessed or candidates
+            best = max(pool, key=lambda c: c["sigs_per_sec"] or 0)
+            result["last_live_tpu_capture"] = best
+            overall = max(candidates, key=lambda c: c["sigs_per_sec"] or 0)
+            if overall["source"] != best["source"]:
+                result["max_live_tpu_capture_any_round"] = overall
     except Exception:
         pass
     print(json.dumps(result))

@@ -470,12 +470,19 @@ _tls = threading.local()
 
 _comb_device_dispatches = 0
 
+# Guards the module-global totals only: BatchingVerifier runs up to
+# max_inflight backend calls concurrently and a bare += can drop counts
+# (ADVICE r4).  The thread-local counters need no lock.
+_dispatch_count_lock = threading.Lock()
+
 
 def _note_dispatch(comb: bool = False) -> None:
     global _device_dispatches, _comb_device_dispatches
-    _device_dispatches += 1
+    with _dispatch_count_lock:
+        _device_dispatches += 1
+        if comb:
+            _comb_device_dispatches += 1
     if comb:
-        _comb_device_dispatches += 1
         _tls.comb = getattr(_tls, "comb", 0) + 1
     else:
         _tls.general = getattr(_tls, "general", 0) + 1
@@ -619,6 +626,13 @@ class JaxBatchBackend:
         ):
             return None
         return self._ready_comb.get(bucket)
+
+    def comb_ready_buckets(self) -> list:
+        """Sorted buckets with a compiled comb program — snapshot taken
+        under the backend lock so stats readers never race the background
+        comb-warm threads' dict inserts (ADVICE r4)."""
+        with self._lock:
+            return sorted(self._ready_comb)
 
     def _call_verify(
         self,

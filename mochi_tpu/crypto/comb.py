@@ -444,11 +444,19 @@ def verify_comb_prepared(
         # basepoint rows for ALL windows with ONE one-hot matmul: the 0/1
         # selector and <2^15 limb values are exact in f32, and XLA places
         # the (64, B, 9) x (64, 9, 51) batched matmul on the MXU.
+        # precision=HIGHEST: TPU's default f32 matmul decomposes through
+        # bf16 passes (8-bit mantissa) that would truncate the 15-bit limb
+        # values — same hazard _mul_mxu (field.py) documents; CPU's
+        # full-f32 default masks it in tests.
         onehot = (
             s_mag[:, :, None] == jnp.arange(N_ENTRIES, dtype=jnp.int32)
         ).astype(jnp.float32)
         b_rows = jnp.einsum(
-            "wbe,wec->wbc", onehot, b_tab.astype(jnp.float32)
+            "wbe,wec->wbc",
+            onehot,
+            b_tab.astype(jnp.float32),
+            precision=lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32,
         ).astype(jnp.int32)
         b_flat = b_rows.reshape(N_WINDOWS * B, ROW_WIDTH).T
         bypx, bymx, bxy2d = signed_niels(b_flat, s_neg.reshape(-1))

@@ -238,18 +238,28 @@ class RpcServer:
 
             path = self._unix_path
             if os.path.exists(path):
+                # Only a refused connect (or a path that vanished under us)
+                # proves the previous owner is dead.  A timeout or EAGAIN can
+                # mean a LIVE server with a momentarily full accept backlog —
+                # unlinking then would steal its path and strand it
+                # running-but-unreachable (ADVICE r4).
                 probe = _socket.socket(_socket.AF_UNIX)
                 probe.settimeout(0.2)
+                stale = False
                 try:
                     probe.connect(path)
+                except (ConnectionRefusedError, FileNotFoundError):
+                    stale = True
+                except OSError:
+                    pass  # timeout / EAGAIN / anything else: assume live
+                finally:
                     probe.close()
+                if not stale:
                     raise OSError(f"unix socket {path} is in use by a live server")
-                except (ConnectionRefusedError, _socket.timeout, FileNotFoundError):
-                    probe.close()
-                    try:
-                        os.unlink(path)  # stale socket from a dead process
-                    except FileNotFoundError:
-                        pass
+                try:
+                    os.unlink(path)  # stale socket from a dead process
+                except FileNotFoundError:
+                    pass
             self._server = await loop.create_unix_server(
                 lambda: _RpcServerProtocol(self), path
             )

@@ -18,6 +18,33 @@ embedding applications.
 from __future__ import annotations
 
 import gc
+import hashlib
+import os
+import platform
+
+
+def host_cache_dir(base: str) -> str:
+    """CPU-backend XLA cache subdirectory keyed by this host's CPU features.
+
+    XLA:CPU's persistent cache key does NOT include the CPU feature set its
+    AOT code was specialized for; a cache directory populated on a machine
+    with (say) AVX-512 feeds SIGILL-prone code to a host without it —
+    MULTICHIP_r04.json's tail was full of exactly this machine-feature-
+    mismatch warning (VERDICT r4 item 6).  Every CPU-backend cache site
+    (driver dryrun, bench.py forced-CPU fallback, vpu_peak --allow-cpu)
+    must use this instead of the shared TPU cache dir.
+    """
+    feat = platform.machine()
+    try:
+        with open("/proc/cpuinfo") as fh:
+            for line in fh:
+                if line.startswith(("flags", "Features")):
+                    feat += " " + " ".join(sorted(line.split(":", 1)[1].split()))
+                    break
+    except OSError:
+        pass
+    tag = hashlib.sha256(feat.encode()).hexdigest()[:12]
+    return os.path.join(base, f"cpu-{tag}")
 
 
 def tune_gc_for_server() -> None:

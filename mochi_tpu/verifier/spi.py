@@ -413,7 +413,13 @@ def verifier_stats(verifier) -> dict:
 
         st["comb"] = {
             "registered_signers": len(registry),
-            "ready_buckets": sorted(getattr(backend, "_ready_comb", {})),
+            "ready_buckets": (
+                backend.comb_ready_buckets()
+                if hasattr(backend, "comb_ready_buckets")
+                # foreign backend: copy first so a concurrent insert cannot
+                # raise mid-iteration (ADVICE r4)
+                else sorted(list(getattr(backend, "_ready_comb", {})))
+            ),
             "device_dispatches_process_total": comb_dispatch_count(),
         }
     inner = getattr(verifier, "inner", None)

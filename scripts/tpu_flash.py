@@ -28,6 +28,8 @@ import sys
 import time
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)  # `from bench import _tunnel_rtt_ms` in main()
 
 
 def _log(msg: str) -> None:
@@ -87,6 +89,11 @@ def merge_round_results(round_n: str, key: str, rec: dict) -> str:
     doc[key] = rec
     if (
         rec.get("platform") == "tpu"
+        # headline promotion is for the sigs/sec metric ONLY: other
+        # merged records (vpu_peak: ~1.8e12 int-ops/s) would win the
+        # value comparison and clobber the round's live capture with a
+        # units-confused figure (review r5)
+        and rec.get("metric") == "ed25519_batch_verify_throughput"
         and rec.get("value", 0) > doc.get("headline", {}).get("value", 0)
     ):
         doc["headline"] = rec
@@ -185,6 +192,7 @@ def main(batch: int = 8192, require_tpu: bool = True) -> dict:
             "sequential_sigs_per_sec": round(seq_rate, 1),
             "compile_s": round(compile_s, 1),
             "capture": "flash-seq",
+            "witnessed": os.environ.get("MOCHI_BATTERY") == "1",
             "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         }
         path = merge_round_results(round_n, "flash", prelim)
@@ -208,6 +216,21 @@ def main(batch: int = 8192, require_tpu: bool = True) -> dict:
         pipeline[depth] = round(max(rates), 1)
     best_rate = max(seq_rate, max(pipeline.values()))
 
+    # Tunnel RTT: the dispatch+relay floor every sequential batch pays.
+    # Captured so round-over-round headline deltas can be attributed
+    # (VERDICT r4 weak #7: 111.3k r02 -> 105.1k r04, cause unpinned).
+    # Shared methodology with the full bench (21-sample median tiny-op),
+    # so flash and bench RTT values stay comparable.  Guarded: a tunnel
+    # death during this OPTIONAL diagnostic must not discard the pipelined
+    # capture already measured above (review r5).
+    try:
+        from bench import _tunnel_rtt_ms
+
+        rtt_ms = _tunnel_rtt_ms(dev)
+    except Exception as exc:
+        _log(f"RTT probe failed (capture proceeds): {exc}")
+        rtt_ms = None
+
     sample = items[:256]
     t0 = time.perf_counter()
     for it in sample:
@@ -227,8 +250,51 @@ def main(batch: int = 8192, require_tpu: bool = True) -> dict:
         "compile_s": round(compile_s, 1),
         "cpu_openssl_sigs_per_sec": round(cpu_rate, 1),
         "capture": "flash",
+        "tunnel_rtt_ms": rtt_ms,
+        # compile_s tells warm (<5 s, .jax_cache hit) from cold; recorded
+        # so cache state can explain cross-round deltas
+        "compile_cache": "warm" if compile_s < 5.0 else "cold",
+        # witnessed = captured INSIDE the battery (MOCHI_BATTERY is set by
+        # tpu_measure.sh only), where the watchdog's live probe + log are
+        # the independent witness of the window.  A manual flash run is a
+        # real capture but carries no corroboration, so it must not outrank
+        # watchdog-witnessed numbers in bench.py's preference pool.
+        "witnessed": os.environ.get("MOCHI_BATTERY") == "1",
         "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }
+
+    # One-line delta vs the best PRIOR round's capture at this config
+    # (VERDICT r4 item 5): makes a regression visible the moment it lands.
+    try:
+        import glob as _glob
+
+        prior_best = None
+        for p in sorted(_glob.glob(os.path.join(_REPO, "benchmarks", "results_r*_tpu.json"))):
+            if f"results_r{round_n}_tpu" in p:
+                continue
+            try:
+                with open(p) as fh:
+                    h = json.load(fh).get("headline", {})
+            except Exception:
+                continue
+            if h.get("platform") == "tpu" and h.get("best_batch") == batch and (
+                prior_best is None or h.get("value", 0) > prior_best[1].get("value", 0)
+            ):
+                prior_best = (p, h)
+        if prior_best is not None:
+            pv = prior_best[1]["value"]
+            _log(
+                f"vs best prior capture at batch {batch}: {best_rate:.0f} / {pv:.0f} "
+                f"= {best_rate / pv:.3f}x ({os.path.basename(prior_best[0])}; "
+                f"rtt {rtt_ms} ms, cache {headline['compile_cache']})"
+            )
+            headline["vs_best_prior_capture"] = {
+                "ratio": round(best_rate / pv, 3),
+                "prior_value": pv,
+                "prior_source": os.path.basename(prior_best[0]),
+            }
+    except Exception as exc:
+        _log(f"prior-capture comparison failed: {exc}")
 
     path = merge_round_results(round_n, "flash", headline)
     print("FLASH_JSON " + json.dumps(headline), flush=True)

@@ -29,7 +29,11 @@ set -uo pipefail
 REPO_DIR=$(cd "$(dirname "$0")/.." && pwd)
 cd "$REPO_DIR"
 export PYTHONPATH="${REPO_DIR}${PYTHONPATH:+:$PYTHONPATH}"
-ROUND=${1:-04}
+# Marks child captures as battery-produced: the watchdog's live probe +
+# log witness the window, so records may carry witnessed=true (manual
+# script runs must not — bench.py prefers witnessed captures).
+export MOCHI_BATTERY=1
+ROUND=${1:-05}
 OUT="benchmarks/tpu_measure_r${ROUND}.log"
 DIAG="benchmarks/tpu_probe_diag_r${ROUND}.log"  # latest probe's jax output
 
@@ -125,6 +129,14 @@ timeout 420 python scripts/tpu_flash.py "$ROUND" 2>&1 | tee -a "$OUT"
 step_rc flash "${PIPESTATUS[0]}"
 commit_artifacts "TPU flash capture r${ROUND}: live headline measurement"
 
+echo "== 1c. VPU int32 madd peak (grounds the MFU denominator — VERDICT r4 #3)" | tee -a "$OUT"
+# BEFORE the headline bench: bench.py's MFU accounting prefers the measured
+# benchmarks/vpu_peak.json, which must therefore exist when bench runs
+# (review r5 — after-bench ordering would leave this round's record on the
+# assumed figure).  Cheap: one fori_loop program at 4 shapes, ~19 ms/call.
+run_step vpu_peak 600 device python scripts/vpu_peak.py
+commit_artifacts "TPU battery r${ROUND}: measured VPU int32 peak"
+
 echo "== 2. headline bench" | tee -a "$OUT"
 # Per-milestone resume: a retry battery must not spend ~8 min of a fresh
 # window re-measuring a bench already banked live this round.
@@ -159,6 +171,9 @@ attempt = log.rsplit("== battery attempt", 1)[-1]
 hits = [l for l in attempt.splitlines() if l.startswith('{"metric"')]
 if hits:
     rec = json.loads(hits[-1])
+    if rec.get("platform") == "tpu":
+        # battery-produced: the watchdog's live probe + log witness it
+        rec["witnessed"] = True
     print("merged bench.py record into",
           merge_round_results(round_n, "bench", rec))
     if rec.get("tpu_unreachable"):
@@ -218,7 +233,7 @@ round_n = sys.argv[1]
 log = open(f"benchmarks/tpu_measure_r{round_n}.log").read()
 attempt = log.rsplit("== battery attempt", 1)[-1]
 for tag, key in (("E2E_JSON ", "e2e"), ("FORGERY_JSON ", "forgery"),
-                 ("COMB_JSON ", "comb")):
+                 ("COMB_JSON ", "comb"), ("VPU_PEAK_JSON ", "vpu_peak")):
     hits = [l for l in attempt.splitlines() if l.startswith(tag)]
     if hits:
         print("merged", key, "->",

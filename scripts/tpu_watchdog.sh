@@ -10,7 +10,7 @@
 set -uo pipefail
 REPO_DIR=$(cd "$(dirname "$0")/.." && pwd)
 cd "$REPO_DIR"
-ROUND=${1:-04}
+ROUND=${1:-05}
 LOG="benchmarks/tpu_watchdog_r${ROUND}.log"
 LOCKFILE="/tmp/mochi_tpu_watchdog_r${ROUND}.lock"
 

@@ -41,9 +41,21 @@ def test_flash_capture_dryrun(tmp_path, monkeypatch):
     # cpu platform must NOT claim the round's headline slot
     assert "headline" not in out
 
-    # a tpu-platform record does claim it, and only better ones replace it
-    flash.merge_round_results("97", "x", {"platform": "tpu", "value": 10.0})
-    flash.merge_round_results("97", "y", {"platform": "tpu", "value": 5.0})
+    # a tpu-platform sigs/sec record does claim it, and only better ones
+    # replace it
+    sig = {"metric": "ed25519_batch_verify_throughput", "platform": "tpu"}
+    flash.merge_round_results("97", "x", dict(sig, value=10.0))
+    flash.merge_round_results("97", "y", dict(sig, value=5.0))
+    out = json.load(open(tmp_path / "benchmarks" / "results_r97_tpu.json"))
+    assert out["headline"]["value"] == 10.0
+
+    # other metrics must NOT claim the headline slot even with a huge
+    # value: vpu_peak's ~1.8e12 int-ops/s would clobber the live capture
+    # with a units-confused figure (review r5)
+    flash.merge_round_results(
+        "97", "vpu_peak",
+        {"metric": "vpu_int32_madd_peak", "platform": "tpu", "value": 1.8e12},
+    )
     out = json.load(open(tmp_path / "benchmarks" / "results_r97_tpu.json"))
     assert out["headline"]["value"] == 10.0
 
