@@ -27,17 +27,24 @@ CONFIG_NAMES = {
     "3": "config3_ycsb",
     "4": "config4_viewchange",
     "5": "config5_multichip",
+    "6": "config6_bigcluster",
 }
 
 
 def _run_child(key: str) -> None:
     import jax
 
+    cache_dir = _CACHE_DIR
     if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
         # The axon TPU plugin force-sets jax_platforms via sitecustomize;
         # honor an explicit CPU request by overriding the config knob.
         jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
+        # CPU backend: host-fingerprint-keyed cache (foreign-host XLA:CPU
+        # AOT code can SIGILL — utils/runtime.host_cache_dir docstring)
+        from mochi_tpu.utils.runtime import host_cache_dir
+
+        cache_dir = host_cache_dir(_CACHE_DIR)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
     import importlib
 

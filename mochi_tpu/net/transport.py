@@ -84,7 +84,20 @@ def _run_handler_sync(coro) -> Optional[Envelope]:
 
 
 class _FramedProtocol(asyncio.Protocol):
-    """Length-prefixed framing shared by both transport roles."""
+    """Length-prefixed framing shared by both transport roles.
+
+    Measured and rejected (round 5): coalescing the responses of one
+    ``data_received`` parse batch into a single ``transport.write`` — the
+    envelope-coalescing candidate against the loopback-syscall wall
+    (BASELINE.md).  A/B on config-1 at 5 and 20 clients: within noise both
+    ways, and a frames-per-delivery histogram showed **9320 of 9320**
+    deliveries carry exactly ONE complete frame — every hot edge here is
+    strictly one-in-flight request-response (a client blocks on each txn
+    phase; fan-out targets are distinct sockets), so a per-socket batch
+    never has a second frame to merge.  The syscall wall is irreducible
+    without multi-request pipelining on the client edge, which the 1-RT
+    read / 2-RT write design deliberately avoids.
+    """
 
     def __init__(self) -> None:
         self._buf = bytearray()

@@ -51,7 +51,11 @@ while true; do
   if probe; then
     batteries=$((batteries + 1))
     echo "[watchdog] probe $n LIVE $(date -u +%FT%TZ) — firing battery $batteries/$MAX_BATTERIES" | tee -a "$LOG"
-    bash scripts/tpu_measure.sh "$ROUND" 2>&1 | tail -60 >>"$LOG"
+    # MOCHI_BATTERY=1: this battery is fired off a logged live probe, so
+    # its captures are witnessed (the LIVE line above is the corroboration
+    # bench.py's witnessed-preference relies on).  Manual battery runs do
+    # not get the flag.
+    MOCHI_BATTERY=1 bash scripts/tpu_measure.sh "$ROUND" 2>&1 | tail -60 >>"$LOG"
     rc=${PIPESTATUS[0]}  # the battery's status, not tail's (ADVICE r3)
     echo "[watchdog] battery done $(date -u +%FT%TZ) rc=$rc" | tee -a "$LOG"
     # The battery commits per-milestone; this is the belt-and-braces final
