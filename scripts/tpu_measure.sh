@@ -270,7 +270,10 @@ echo "== 5b. config6 (n=64 f=21) via shared TPU verifier service" | tee -a "$OUT
 # The north-star shape over the TPU-owner topology: 64 replicas ship
 # 43-grant cert checks to one service whose comb registry holds all 64
 # cluster identities (its design size) — VERDICT r4 missing #1.
-run_step config6_service 1800 device python -c "
+# MOCHI_BENCH_FULL: attach the inline-OpenSSL A/B leg (the memoization
+# comparison) — run() gates it on this env var (review r5: without it the
+# battery's record would lack the A/B that the CPU record carries).
+run_step config6_service 1800 device env MOCHI_BENCH_FULL=1 python -c "
 import sys, json
 sys.path.insert(0, 'scripts')
 import jax

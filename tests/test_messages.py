@@ -123,3 +123,35 @@ def test_signing_bytes_cover_content():
         server_id=mg.server_id,
     )
     assert mg.signing_bytes() != mutated.signing_bytes()
+
+
+def test_six_bytes_splice_is_byte_identical():
+    """The payload-level mcode cache (round 5) splices cached payload bytes
+    between a freshly encoded tag and tail; the result must be byte-equal
+    to encoding the whole 6-element list in one call, for EVERY payload
+    type — this is what keeps fan-out envelopes (shared payload, distinct
+    msg_id/MAC) wire-compatible with round-4 peers."""
+    from mochi_tpu.protocol.codec import encode
+    from mochi_tpu.protocol.messages import _TAG_BY_TYPE
+
+    for payload in PAYLOADS:
+        env = Envelope(payload, "msg-1", "sender-1", "reply-1", 1712345678901)
+        reference = encode(
+            [
+                _TAG_BY_TYPE[type(payload)],
+                payload.to_obj(),
+                env.msg_id,
+                env.sender_id,
+                env.reply_to,
+                env.timestamp_ms,
+            ]
+        )
+        assert env._six_bytes == reference, type(payload).__name__
+        # second envelope over the SAME payload object hits the cache and
+        # must produce its own correct bytes (different msg_id)
+        env2 = Envelope(payload, "msg-2", "sender-1", "reply-1", 1712345678901)
+        assert "_mcode" in payload.__dict__
+        reference2 = reference.replace(b"msg-1", b"msg-2")
+        assert env2._six_bytes == reference2, type(payload).__name__
+        decoded = decode_envelope(encode_envelope(env2))
+        assert decoded.payload == payload, type(payload).__name__
