@@ -490,3 +490,39 @@ def test_evolve_carries_keys_and_bumps_stamp():
     shrunk = grown.evolve({f"s{i}": f"127.0.0.1:{9300+i}" for i in range(4)})
     assert shrunk.configstamp == grown.configstamp + 1
     assert "s4" not in shrunk.public_keys
+
+
+def test_reconfig_at_scale_n16():
+    """Live removal from an n=16 rf=16 (f=5, quorum=11) cluster — the
+    round-5 large-cluster shape.  Quorum math shifts under reconfiguration
+    (rf 16 -> 15: f=(15-1)//3=4, quorum 9), and the archive/configstamp
+    chain must hold when every server owns every key.  Pre-reconfig data
+    stays readable and new writes commit with the NEW quorum size."""
+
+    async def main():
+        async with VirtualCluster(16, rf=16) as vc:
+            assert vc.config.f == 5 and vc.config.quorum == 11
+            client = vc.client(timeout_s=30.0)
+            await client.execute_write_transaction(
+                TransactionBuilder().write("big-rk", b"v").build()
+            )
+            servers = current_servers(vc)
+            del servers["server-15"]
+            new_cfg = vc.config.evolve(servers, rf=15)
+            assert new_cfg.f == 4 and new_cfg.quorum == 9
+            await client.reconfigure_cluster(new_cfg)
+
+            # pre-reconfig key readable; new write commits under new quorum
+            await client.execute_write_transaction(
+                TransactionBuilder().write("big-rk2", b"w").build()
+            )
+            res = await client.execute_read_transaction(
+                TransactionBuilder().read("big-rk").read("big-rk2").build()
+            )
+            assert [r.value for r in res.operations] == [b"v", b"w"]
+            cert = res.operations[1].current_certificate
+            assert cert is not None and len(cert.grants) == new_cfg.quorum
+            retired = vc.replica("server-15")
+            assert "server-15" not in retired.config.servers
+
+    run(main())

@@ -1,0 +1,65 @@
+"""CPU dry-run of the battery's VPU-peak step (scripts/vpu_peak.py).
+
+The measured peak becomes the MFU denominator for every subsequent bench
+record (bench._measured_vpu_peak), and the step runs unattended in a live
+TPU window — a bug found on-chip wastes the window (same rationale as
+test_flash_dryrun).  Checks: the record shape bench.py consumes, the
+RTT-domination guard (a flagged config must never set the headline), and
+that a CPU run never writes benchmarks/vpu_peak.json (a host-core number
+must not become the chip's denominator).
+"""
+
+import importlib.util
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location(
+        "vpu_peak", os.path.join(REPO, "scripts", "vpu_peak.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_vpu_peak_cpu_dryrun(tmp_path, monkeypatch):
+    mod = _load()
+    monkeypatch.setattr(mod, "_REPO", str(tmp_path))
+    os.makedirs(tmp_path / "benchmarks")
+    rec = mod.measure(allow_cpu=True)
+    assert rec["metric"] == "vpu_int32_madd_peak"
+    assert rec["value"] > 0
+    assert rec["platform"] == "cpu"
+    assert rec["unit"] == "int_ops/sec"
+    assert isinstance(rec["tunnel_rtt_ms"], float)
+    for cfg in rec["table"].values():
+        assert cfg["int_ops_per_sec_raw"] <= cfg["int_ops_per_sec"] * 1.001
+    # bench.py's consumer contract: these are the keys it reads
+    assert set(rec) >= {"value", "platform", "table", "measured_over_assumed"}
+    # CPU runs must NOT write the file the MFU accounting prefers
+    assert not os.path.exists(tmp_path / "benchmarks" / "vpu_peak.json")
+
+
+def test_bench_prefers_measured_peak(tmp_path, monkeypatch):
+    import json
+
+    import bench
+
+    monkeypatch.setattr(bench, "_REPO", str(tmp_path))
+    os.makedirs(tmp_path / "benchmarks")
+    # no file -> assumed figure
+    peak, src = bench._measured_vpu_peak()
+    assert peak == bench.VPU_PEAK_INT_OPS and "assumed" in src
+    # tpu-measured file -> preferred
+    with open(tmp_path / "benchmarks" / "vpu_peak.json", "w") as fh:
+        json.dump({"platform": "tpu", "value": 2.5e12}, fh)
+    peak, src = bench._measured_vpu_peak()
+    assert peak == 2.5e12 and "measured" in src
+    # a cpu-platform file must be ignored
+    with open(tmp_path / "benchmarks" / "vpu_peak.json", "w") as fh:
+        json.dump({"platform": "cpu", "value": 9.9e12}, fh)
+    peak, _ = bench._measured_vpu_peak()
+    assert peak == bench.VPU_PEAK_INT_OPS
