@@ -119,7 +119,7 @@ def main(batch: int = 8192, require_tpu: bool = True) -> dict:
     bug discovered ON the chip would waste the live window it exists to
     exploit.  Production always runs the defaults (8192 = the round-2
     capture-D peak, chip required)."""
-    round_n = sys.argv[1] if len(sys.argv) > 1 else "04"
+    round_n = sys.argv[1] if len(sys.argv) > 1 else "05"
 
     # Retry batteries re-run the flash first; a window already banked this
     # round must not be spent re-measuring the same number (the remaining
