@@ -11,7 +11,6 @@ must not become the chip's denominator).
 
 import importlib.util
 import os
-import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
