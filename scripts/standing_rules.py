@@ -1,0 +1,144 @@
+"""Evaluate the standing decision rules against a round's TPU results.
+
+ROUND4.md §"Standing decision rules" (carried into round 5, plus the r5
+config6 rule) pre-commits how each battery measurement is acted on, so
+the data's arrival needs analysis, not re-litigation.  This script is
+that analysis: it reads ``benchmarks/results_r{N}_tpu.json`` and prints a
+rule-by-rule verdict with the recommended action — READ-ONLY (flipping a
+default is a reviewed code edit, never automatic).
+
+Usage: python scripts/standing_rules.py [round-suffix]   (default 05)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> None:
+    round_n = sys.argv[1] if len(sys.argv) > 1 else "05"
+    path = os.path.join(_REPO, "benchmarks", f"results_r{round_n}_tpu.json")
+    if not os.path.exists(path):
+        print(f"no {path} — no live captures this round yet")
+        return
+    with open(path) as fh:
+        doc = json.load(fh)
+
+    verdicts = []
+
+    # Rule 1: comb impl + promotion
+    comb = doc.get("comb") or {}
+    impl_ab = comb.get("impl_ab") or {}
+    if impl_ab:
+        chain, tree = impl_ab.get("chain", 0), impl_ab.get("tree", 0)
+        if chain and tree:
+            if tree > chain * 1.10:
+                verdicts.append(
+                    f"rule 1a: TREE wins {tree/chain:.2f}x (> 1.10) -> flip "
+                    "COMB_IMPL default to tree (crypto/comb.py)"
+                )
+            else:
+                verdicts.append(
+                    f"rule 1a: chain stays ({tree/chain:.2f}x tree/chain, "
+                    "needs > 1.10 to flip) — record and keep"
+                )
+    by_k = comb.get("comb_by_signers") or {}
+    promo = [
+        (k, v["speedup_vs_ladder"])
+        for k, v in by_k.items()
+        if k in ("16", "64") and v.get("speedup_vs_ladder", 0) >= 2.0
+    ]
+    if by_k:
+        if promo:
+            verdicts.append(
+                f"rule 1b: comb >= 2x at K={[k for k, _ in promo]} "
+                f"({promo}) -> promote comb number to BASELINE config-2 "
+                "record ALONGSIDE the general-path headline, labeled by posture"
+            )
+        else:
+            best = max((v.get("speedup_vs_ladder", 0) for v in by_k.values()), default=0)
+            verdicts.append(
+                f"rule 1b: comb best {best:.2f}x vs ladder (< 2x at K=16/64) "
+                "-> general-path headline stands alone; record the ratio"
+            )
+
+    # Rule 2: e2e fraction
+    e2e = doc.get("e2e") or {}
+    frac = e2e.get("e2e_fraction_of_pipelined")
+    if frac is not None:
+        if frac >= 0.90:
+            verdicts.append(f"rule 2: e2e fraction {frac} >= 0.90 — goal met")
+        else:
+            verdicts.append(
+                f"rule 2: e2e fraction {frac} < 0.90 -> attack the residual "
+                "the per-phase timings name (and NOTHING else): "
+                + json.dumps({k: v for k, v in e2e.items() if "_s" in k or "phase" in k})[:300]
+            )
+
+    # Rule 3: bucket/select re-runs
+    ab = doc.get("ab_ladder") or {}
+    if ab.get("select_winner"):
+        sel = ab.get("select_rates") or {}
+        verdicts.append(
+            f"rule 3: select winner {ab['select_winner']} "
+            f"({sel if sel else 'rates in log'}) — flip MOCHI_SELECT_IMPL "
+            "only on a > 5% win; clean r05 numbers supersede the contended "
+            "03:16Z sweep"
+        )
+    if ab.get("max_bucket_winner"):
+        verdicts.append(f"rule 3b: MAX_BUCKET winner {ab['max_bucket_winner']}")
+
+    # Rule 4: roofline (human-readable in the log; JSON not merged)
+    verdicts.append(
+        "rule 4: roofline — read the full/parts ratio in "
+        f"benchmarks/tpu_measure_r{round_n}.log: > 1.5 means schedule-bound "
+        "(tree comb doubles as the fix probe); parts-bound means the biggest "
+        "row is the next kernel target"
+    )
+
+    # Rule 5: pallas
+    pr = os.path.join(_REPO, "benchmarks", "pallas_retry.json")
+    if os.path.exists(pr):
+        with open(pr) as fh:
+            verdicts.append(f"rule 5: pallas retry recorded — {fh.read()[:200]} "
+                            "(final for this codebase generation; north-star "
+                            "clause satisfied-by-XLA)")
+    else:
+        verdicts.append("rule 5: benchmarks/pallas_retry.json not yet recorded")
+
+    # Rule 6 (r5): config6 service posture
+    c6 = doc.get("config6_service") or {}
+    n64 = c6.get("n64_f21") or {}
+    if n64:
+        tpu_rate = n64.get("txn_per_s", 0)
+        host_rate = 8.83  # published host-core service record (results_r05.json)
+        verdicts.append(
+            f"rule 6: config6 TPU-service n64 {tpu_rate} txn/s vs host-core "
+            f"{host_rate} -> "
+            + ("record as production posture for BASELINE published.6"
+               if tpu_rate >= host_rate else
+               "keep host record; note the TPU-service number and its comb_registration field")
+        )
+
+    # VPU peak grounding
+    vp = doc.get("vpu_peak") or {}
+    if vp.get("value"):
+        verdicts.append(
+            f"vpu peak: measured {vp['value']/1e12:.3f} T int-ops/s "
+            f"({vp.get('measured_over_assumed', '?')}x of the assumed 1.8e12) — "
+            "bench.py MFU now uses this denominator"
+        )
+
+    print(f"== standing-rule verdicts for round {round_n} ==")
+    for v in verdicts:
+        print(" -", v)
+    if not verdicts:
+        print(" - results file exists but carries none of the rule inputs yet")
+
+
+if __name__ == "__main__":
+    main()
