@@ -310,5 +310,10 @@ echo "== 6. bounded Pallas retry (time-boxed; VERDICT r3 #9)" | tee -a "$OUT"
 run_step pallas_retry 1800 device python scripts/pallas_retry.py 600
 commit_artifacts "TPU battery r${ROUND}: config1 service + pallas retry"
 
+echo "== 7. standing-rule verdicts (read-only analysis of this round's captures)" | tee -a "$OUT"
+python scripts/standing_rules.py "$ROUND" 2>&1 | tee -a "$OUT"
+step_rc standing_rules "${PIPESTATUS[0]}" host
+commit_artifacts "TPU battery r${ROUND}: standing-rule verdicts"
+
 echo "DONE (failed_steps=$FAILED) — artifacts committed per-milestone; see benchmarks/results_r${ROUND}_tpu.json and $OUT" | tee -a "$OUT"
 [ "$FAILED" -eq 0 ]
