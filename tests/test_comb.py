@@ -457,3 +457,64 @@ def test_comb_table_math_against_host_ints(signers):
     np.testing.assert_array_equal(
         row[2 * F.NLIMBS :], F.int_to_limbs(2 * F.D_INT * ax % P * ay % P)
     )
+
+
+def test_device_matmuls_pin_highest_precision():
+    """Every dot_general in the comb programs must carry explicit
+    Precision.HIGHEST: TPU's DEFAULT f32 matmul decomposes through bf16
+    passes whose 8-bit mantissa truncates the 15-bit table limbs — wrong
+    basepoint rows, valid signatures rejected (ADVICE r4 medium; the CPU
+    backend computes full f32 either way, which is exactly why a numeric
+    test here cannot catch it and this structural check exists)."""
+    import jax
+
+    from mochi_tpu.crypto.batch_verify import prepare_packed
+
+    reg = comb.SignerRegistry()
+    kps = [keys.keypair_from_seed(bytes([i + 1] * 32)) for i in range(2)]
+    for kp in kps:
+        assert reg.register(kp.public_key) is not None
+    items = [
+        VerifyItem(kp.public_key, b"p%d" % i, kp.sign(b"p%d" % i))
+        for i, kp in enumerate(kps)
+    ]
+    _, _, y_r, sign_r, s_sc, h_sc, ok = prepare_packed(items)
+    assert ok.all()
+    key_idx = np.asarray(
+        [reg.index_of(it.public_key) for it in items], dtype=np.int32
+    )
+    table = reg.device_table()
+
+    def dot_precisions(jaxpr, out):
+        for eqn in jaxpr.eqns:
+            if eqn.primitive.name == "dot_general":
+                out.append(eqn.params.get("precision"))
+            for v in eqn.params.values():
+                for x in v if isinstance(v, (list, tuple)) else (v,):
+                    if hasattr(x, "jaxpr"):
+                        dot_precisions(x.jaxpr, out)
+        return out
+
+    from jax import lax
+
+    for impl, expect_dots in (("tree", True), ("chain", False)):
+        jx = jax.make_jaxpr(
+            lambda *a: comb.verify_comb_prepared(*a, impl=impl)
+        )(table, key_idx, y_r, sign_r, s_sc, h_sc)
+        precs = dot_precisions(jx.jaxpr, [])
+        assert bool(precs) == expect_dots, (impl, precs)
+        for p in precs:
+            assert p == (lax.Precision.HIGHEST, lax.Precision.HIGHEST), (impl, p)
+
+    # Same hazard, same pin for the MXU column-reduction multiply
+    # (MOCHI_SKEW_IMPL=mxu; field.py:_mul_mxu documents the bound proof).
+    import jax.numpy as jnp
+
+    from mochi_tpu.crypto import field as F
+
+    a = jnp.ones((F.NLIMBS, 4), jnp.int32)
+    jx = jax.make_jaxpr(F._mul_mxu)(a, a)
+    precs = dot_precisions(jx.jaxpr, [])
+    assert precs, "mxu multiply lost its dot_general"
+    for p in precs:
+        assert p == (lax.Precision.HIGHEST, lax.Precision.HIGHEST), p
