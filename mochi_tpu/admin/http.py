@@ -127,6 +127,8 @@ class HttpJsonServer:
             writer.close()
             try:
                 await writer.wait_closed()
+            except asyncio.CancelledError:
+                raise
             except Exception:
                 pass
 

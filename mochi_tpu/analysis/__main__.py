@@ -1,0 +1,100 @@
+"""CLI for the static-analysis pass.
+
+Exit codes: 0 clean (new findings == 0), 1 new findings, 2 usage error.
+``--write-baseline`` records the current findings as accepted and exits 0 —
+the ratchet for landing the pass on a tree with known debt.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List
+
+from .core import all_rules, run, write_baseline
+
+DEFAULT_BASELINE = os.path.join("config", "analysis_baseline.json")
+
+
+def main(argv: List[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m mochi_tpu.analysis",
+        description="mochi-tpu project-native static analysis",
+    )
+    parser.add_argument(
+        "paths", nargs="*", default=["mochi_tpu"],
+        help="files or directories to scan (default: mochi_tpu)",
+    )
+    parser.add_argument(
+        "--rules",
+        help=f"comma-separated subset of: {', '.join(all_rules())}",
+    )
+    parser.add_argument(
+        "--baseline", default=None,
+        help=f"baseline JSON (default: {DEFAULT_BASELINE} if it exists)",
+    )
+    parser.add_argument(
+        "--write-baseline", action="store_true",
+        help="accept current findings into the baseline file and exit 0",
+    )
+    parser.add_argument(
+        "--no-path-filter", action="store_true",
+        help="drop per-checker path scoping (fixture/self-test use)",
+    )
+    parser.add_argument(
+        "--format", choices=("text", "json"), default="text",
+    )
+    args = parser.parse_args(argv)
+
+    rules = None
+    if args.rules:
+        rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+    baseline = args.baseline
+    if baseline is None and os.path.exists(DEFAULT_BASELINE):
+        baseline = DEFAULT_BASELINE
+
+    try:
+        result = run(
+            args.paths,
+            rules=rules,
+            baseline=None if args.write_baseline else baseline,
+            scoped=not args.no_path_filter,
+        )
+    except ValueError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        target = args.baseline or DEFAULT_BASELINE
+        os.makedirs(os.path.dirname(target) or ".", exist_ok=True)
+        write_baseline(target, result.new)
+        print(f"baseline written: {target} ({len(result.new)} findings)")
+        return 0
+
+    if args.format == "json":
+        print(
+            json.dumps(
+                {
+                    "new": [f.__dict__ | {"fingerprint": f.fingerprint} for f in result.new],
+                    "baselined": len(result.baselined),
+                    "suppressed": len(result.suppressed),
+                    "files_scanned": result.files_scanned,
+                },
+                indent=2,
+            )
+        )
+    else:
+        for finding in result.new:
+            print(finding.render())
+        print(
+            f"{result.files_scanned} files scanned: {len(result.new)} new, "
+            f"{len(result.baselined)} baselined, "
+            f"{len(result.suppressed)} suppressed"
+        )
+    return 1 if result.new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

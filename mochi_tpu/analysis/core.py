@@ -1,0 +1,343 @@
+"""Framework for the project-native static-analysis pass.
+
+Why a bespoke pass instead of an off-the-shelf linter: the defects that
+actually hurt this codebase are *protocol-specific* — a ``time.sleep`` inside
+a replica coroutine stalls every connection multiplexed on that event loop
+(the 1-RT read / 2-RT write budget is milliseconds); an ``except Exception``
+that eats ``asyncio.CancelledError`` turns shutdown into a hang; a Python
+``if`` on a traced value silently forces a host sync inside the batched
+verifier; a ``==`` on signature bytes is a timing oracle.  Generic linters
+know none of this vocabulary.
+
+Architecture: each checker module exposes ``RULE`` (its name) and
+``check(tree, src, path, scoped=True) -> list[Finding]``.  This module owns
+the shared plumbing: the :class:`Finding` type, suppression comments,
+baseline files, file walking, and the runner.
+
+Suppression syntax (see docs/ANALYSIS.md): a finding on line N is suppressed
+by a comment on line N or on line N-1 of the form::
+
+    # mochi-lint: disable=<rule>[,<rule>...]
+    # mochi-lint: disable=all
+
+Baseline: a JSON file ``{"fingerprints": [...]}``.  Findings whose
+fingerprint appears in the baseline are reported as "baselined" and do not
+fail the run — the mechanism that lets the pass land on an imperfect tree
+and ratchet forward.  The shipped baseline is empty: every finding on the
+current tree is either fixed or carries an explicit suppression.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+import re
+from dataclasses import dataclass, field, replace
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One checker hit, addressable and stable enough to baseline."""
+
+    rule: str
+    path: str  # posix-style, package-anchored (see display_path)
+    line: int
+    col: int
+    message: str
+    snippet: str = ""
+    # 0-based index among same-(rule, snippet) findings in this file, in
+    # line order; assigned by run().  Without it, two textually identical
+    # violations in one file would share a fingerprint and one baseline
+    # entry would grandfather both — the ratchet could move backwards.
+    occurrence: int = 0
+
+    @property
+    def fingerprint(self) -> str:
+        """Content-addressed id: survives line-number drift (the baseline
+        must not churn when unrelated edits move code), breaks when the
+        flagged code itself changes (a moved-AND-edited line is a new
+        finding, as it should be)."""
+        basis = f"{self.rule}|{self.path}|{self.snippet.strip()}|{self.occurrence}"
+        return hashlib.sha256(basis.encode()).hexdigest()[:16]
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] {self.message}"
+
+
+# --------------------------------------------------------------- suppressions
+
+_SUPPRESS_RE = re.compile(r"#\s*mochi-lint:\s*disable=([A-Za-z0-9_,\- ]+)")
+
+
+def suppressions_by_line(src: str) -> Dict[int, Set[str]]:
+    """Map 1-based line number -> set of rule names disabled there.
+
+    Regex over raw lines rather than the tokenize module: a suppression must
+    keep working even in a file the tokenizer rejects (the parse-error path
+    still reports, and half-edited files shouldn't crash the linter)."""
+    out: Dict[int, Set[str]] = {}
+    for i, line in enumerate(src.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(line)
+        if m:
+            out[i] = {r.strip() for r in m.group(1).split(",") if r.strip()}
+    return out
+
+
+def is_suppressed(finding: Finding, supp: Dict[int, Set[str]]) -> bool:
+    for line in (finding.line, finding.line - 1):
+        rules = supp.get(line)
+        if rules and ("all" in rules or finding.rule in rules):
+            return True
+    return False
+
+
+# ------------------------------------------------------------------- baseline
+
+
+def load_baseline(path: Optional[str]) -> Set[str]:
+    if not path or not os.path.exists(path):
+        return set()
+    with open(path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    return set(doc.get("fingerprints", []))
+
+
+def write_baseline(path: str, findings: Sequence[Finding]) -> None:
+    doc = {
+        "comment": (
+            "mochi_tpu.analysis baseline: findings listed here are "
+            "grandfathered and do not fail the run.  Regenerate with "
+            "`python -m mochi_tpu.analysis --write-baseline`."
+        ),
+        "fingerprints": sorted({f.fingerprint for f in findings}),
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=2)
+        fh.write("\n")
+
+
+# ----------------------------------------------------------------- AST helpers
+
+
+def build_import_map(tree: ast.Module) -> Dict[str, str]:
+    """Local name -> dotted origin, for resolving what a call really is.
+
+    ``import numpy as np``          -> {"np": "numpy"}
+    ``from time import sleep``      -> {"sleep": "time.sleep"}
+    ``from ..crypto import keys``   -> {"keys": "crypto.keys"} (relative
+    imports resolve to their suffix; matching is by dotted-suffix anyway).
+    """
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                out[alias.asname or alias.name.split(".")[0]] = alias.name
+        elif isinstance(node, ast.ImportFrom):
+            base = node.module or ""
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                origin = f"{base}.{alias.name}" if base else alias.name
+                out[alias.asname or alias.name] = origin
+    return out
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def resolve_call(node: ast.AST, imports: Dict[str, str]) -> Optional[str]:
+    """Fully-ish qualified dotted name of a call target, via the import map."""
+    dn = dotted_name(node)
+    if dn is None:
+        return None
+    head, _, rest = dn.partition(".")
+    origin = imports.get(head)
+    if origin is None:
+        return dn
+    return f"{origin}.{rest}" if rest else origin
+
+
+def suffix_match(qualified: str, patterns: Iterable[str]) -> Optional[str]:
+    """Match ``a.b.c.d`` against patterns by dotted suffix (relative imports
+    lose their package prefix, so ``crypto.keys.sign`` must match
+    ``mochi_tpu.crypto.keys.sign`` and vice versa).
+
+    A bare single-segment name only matches a single-segment pattern: a
+    module-local ``wait()`` or ``verify()`` must not trip deny-list entries
+    like ``os.wait`` just because the terminal segment collides — the name
+    carries no evidence it is that module's function."""
+    parts = qualified.split(".")
+    for pat in patterns:
+        pp = pat.split(".")
+        if len(pp) <= len(parts) and parts[-len(pp):] == pp:
+            return pat
+        if 2 <= len(parts) <= len(pp) and pp[-len(parts):] == parts:
+            return pat
+    return None
+
+
+def snippet_at(src_lines: Sequence[str], line: int) -> str:
+    if 1 <= line <= len(src_lines):
+        return src_lines[line - 1].strip()
+    return ""
+
+
+# --------------------------------------------------------------------- runner
+
+
+def display_path(fp: str, scan_root: Optional[str] = None) -> str:
+    """The path a finding carries (and its fingerprint hashes).
+
+    Must be (a) CWD-independent — lint.sh scans from the repo root while
+    scripts/standing_rules.py passes absolute paths from an arbitrary CWD,
+    and a fingerprint mismatch silently un-baselines everything — and
+    (b) scope-faithful — per-checker scoping looks for components like
+    ``crypto/`` and suffixes like ``cluster/config.py``, so a bare-basename
+    display would both drop checkers and break exemptions on single-file
+    invocations.  For a file inside a package, anchor at the package root
+    (walk up while ``__init__.py`` exists): ``keys.py`` displays as
+    ``mochi_tpu/crypto/keys.py`` however it was named.  Otherwise anchor at
+    the scan root (directory scans) or the containing directory (file args).
+    """
+    ap = os.path.abspath(fp)
+    pkg_root = os.path.dirname(ap)
+    while os.path.exists(os.path.join(pkg_root, "__init__.py")):
+        pkg_root = os.path.dirname(pkg_root)
+    if pkg_root != os.path.dirname(ap):
+        return os.path.relpath(ap, pkg_root).replace(os.sep, "/")
+    if scan_root is not None:
+        root_name = os.path.basename(os.path.abspath(scan_root))
+        rel = os.path.relpath(fp, scan_root)
+        return os.path.join(root_name, rel).replace(os.sep, "/")
+    parent = os.path.basename(os.path.dirname(ap))
+    name = os.path.basename(ap)
+    return f"{parent}/{name}" if parent else name
+
+
+def iter_python_files(paths: Sequence[str]) -> List[Tuple[str, str]]:
+    """``(display_path, filesystem_path)`` pairs for every .py under paths."""
+    out: Dict[str, Tuple[str, str]] = {}  # abspath -> (display, fs path)
+    for path in paths:
+        norm = os.path.normpath(path)
+        if os.path.isfile(norm):
+            if norm.endswith(".py"):
+                out.setdefault(os.path.abspath(norm), (display_path(norm), norm))
+            continue
+        for dirpath, dirnames, filenames in os.walk(norm):
+            dirnames[:] = [
+                d for d in dirnames if not d.startswith(".") and d != "__pycache__"
+            ]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    fp = os.path.join(dirpath, fn)
+                    out.setdefault(
+                        os.path.abspath(fp), (display_path(fp, scan_root=norm), fp)
+                    )
+    return sorted(out.values())
+
+
+def _checkers():
+    # Imported here (not module top) so ``core`` stays importable from the
+    # checker modules themselves without a cycle.
+    from . import (
+        async_blocking,
+        cancellation,
+        const_time,
+        invariants,
+        trace_safety,
+    )
+
+    return [async_blocking, cancellation, trace_safety, const_time, invariants]
+
+
+def all_rules() -> List[str]:
+    return [mod.RULE for mod in _checkers()]
+
+
+@dataclass
+class RunResult:
+    """Everything a caller (CLI, test, bench gate) needs to render a run."""
+
+    new: List[Finding] = field(default_factory=list)
+    baselined: List[Finding] = field(default_factory=list)
+    suppressed: List[Finding] = field(default_factory=list)
+    files_scanned: int = 0
+
+    @property
+    def clean(self) -> bool:
+        return not self.new
+
+
+def run(
+    paths: Sequence[str],
+    rules: Optional[Sequence[str]] = None,
+    baseline: Optional[str] = None,
+    scoped: bool = True,
+) -> RunResult:
+    """Run the pass over ``paths`` (files or directories).
+
+    ``rules`` restricts to a subset of checkers; ``scoped=False`` drops the
+    per-checker path scoping (used by the fixture tests, whose snippets live
+    under tests/ where e.g. the trace-safety scope would never look).
+    """
+    checkers = _checkers()
+    if rules is not None:
+        wanted = set(rules)
+        unknown = wanted - {mod.RULE for mod in checkers}
+        if unknown:
+            raise ValueError(f"unknown rules: {sorted(unknown)}")
+        checkers = [mod for mod in checkers if mod.RULE in wanted]
+    known = load_baseline(baseline)
+    result = RunResult()
+    for rel, filepath in iter_python_files(paths):
+        try:
+            with open(filepath, encoding="utf-8") as fh:
+                src = fh.read()
+        except (OSError, UnicodeDecodeError) as exc:
+            result.new.append(
+                Finding("parse-error", rel, 1, 0, f"unreadable: {exc}")
+            )
+            continue
+        try:
+            tree = ast.parse(src, filename=rel)
+        except SyntaxError as exc:
+            result.new.append(
+                Finding(
+                    "parse-error", rel, exc.lineno or 1, exc.offset or 0,
+                    f"syntax error: {exc.msg}",
+                )
+            )
+            continue
+        result.files_scanned += 1
+        supp = suppressions_by_line(src)
+        file_findings: List[Finding] = []
+        for mod in checkers:
+            file_findings.extend(mod.check(tree, src, rel, scoped=scoped))
+        # Occurrence indices in deterministic (line, col) order, so each of
+        # N identical snippets gets its own fingerprint (see Finding).
+        seen_snippets: Dict[Tuple[str, str], int] = {}
+        for finding in sorted(file_findings, key=lambda f: (f.line, f.col)):
+            key = (finding.rule, finding.snippet.strip())
+            idx = seen_snippets.get(key, 0)
+            seen_snippets[key] = idx + 1
+            if idx:
+                finding = replace(finding, occurrence=idx)
+            if is_suppressed(finding, supp):
+                result.suppressed.append(finding)
+            elif finding.fingerprint in known:
+                result.baselined.append(finding)
+            else:
+                result.new.append(finding)
+    return result

@@ -250,6 +250,8 @@ class MochiDBClient:
             )
             try:
                 res = await self.pool.send_and_receive(info, env, self.timeout_s)
+            except asyncio.CancelledError:
+                raise
             except Exception as exc:
                 LOG.debug("session handshake with %s failed: %s", sid, exc)
                 return  # fall back to signed envelopes
@@ -486,6 +488,8 @@ class MochiDBClient:
         txn = Transaction((Operation(Action.READ, CONFIG_CLUSTER_KEY),))
         try:
             result = await self.execute_read_transaction(txn)
+        except asyncio.CancelledError:
+            raise
         except Exception:
             return False
         value = result.operations[0].value
@@ -798,6 +802,8 @@ class MochiDBClient:
         env = self._envelope(NudgeSyncToServer(tuple(sorted(keys))), msg_id)
         try:
             await self.pool.send_and_receive(info, env, timeout_s=2.0)
+        except asyncio.CancelledError:
+            raise
         except Exception:
             pass
 

@@ -1,0 +1,261 @@
+"""Pure-Python Ed25519 + X25519 host fallback (no ``cryptography`` needed).
+
+The host ``cryptography`` wheel (OpenSSL) is an *optional* accelerator: on a
+bare ``numpy+jax+pytest`` environment :mod:`mochi_tpu.crypto.keys` and
+:mod:`mochi_tpu.crypto.session` fall back to this module, so every layer —
+replicas, clients, the verifier service, the whole test tree — runs without
+it.  Built on the repo's own curve arithmetic: the constants and the
+extended-coordinate a=-1 addition law below are the integer originals of
+:mod:`mochi_tpu.crypto.field` / :mod:`mochi_tpu.crypto.curve` (same
+add-2008-hwcd-3 formulas the JAX data plane traces; see ``curve.add``),
+evaluated on Python ints instead of limb tensors.
+
+Semantics match :func:`mochi_tpu.crypto.keys.verify` exactly: callers run
+the strict canonical-encoding prechecks first, then this module applies the
+cofactorless check ``[S]B == R + [h]A`` — the same verdict OpenSSL and the
+TPU batch path produce, so a mixed cluster (some nodes with OpenSSL, some
+without) still agrees on every signature, which BFT safety requires.
+
+NOT constant-time: Python big-int arithmetic is variable-time by nature and
+the double-and-add ladder branches on scalar bits.  That is an accepted
+property of the *fallback* (same posture as the reference's total absence
+of crypto); production deployments install ``cryptography``.  The
+variable-time operations are confined to this module so the constant-time
+checker's scope stays meaningful everywhere else.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from functools import lru_cache
+from typing import Optional, Tuple
+
+from .field import BX_INT, BY_INT, D_INT, L_INT, P_INT, SQRT_M1_INT
+
+_P = P_INT
+_L = L_INT
+_D2 = (2 * D_INT) % _P
+
+# Extended twisted-Edwards coordinates (X, Y, Z, T): x = X/Z, y = Y/Z,
+# T = XY/Z — the exact layout of ``curve.Point``, on ints.
+_Pt = Tuple[int, int, int, int]
+_IDENT: _Pt = (0, 1, 1, 0)
+_BASE: _Pt = (BX_INT, BY_INT, 1, (BX_INT * BY_INT) % _P)
+
+
+def _pt_add(p: _Pt, q: _Pt) -> _Pt:
+    """Complete unified addition (add-2008-hwcd-3, a=-1) — ``curve.add``
+    on ints.  Complete: also serves as doubling and handles the identity."""
+    x1, y1, z1, t1 = p
+    x2, y2, z2, t2 = q
+    a = (y1 - x1) * (y2 - x2) % _P
+    b = (y1 + x1) * (y2 + x2) % _P
+    c = t1 * _D2 % _P * t2 % _P
+    d = 2 * z1 * z2 % _P
+    e = b - a
+    f = d - c
+    g = d + c
+    h = b + a
+    return (e * f % _P, g * h % _P, f * g % _P, e * h % _P)
+
+
+def _scalar_mul(k: int, p: _Pt) -> _Pt:
+    """Variable-base [k]P, MSB-first double-and-add (variable-time — see
+    module docstring)."""
+    acc = _IDENT
+    for bit in bin(k)[2:] if k else "":
+        acc = _pt_add(acc, acc)
+        if bit == "1":
+            acc = _pt_add(acc, p)
+    return acc
+
+
+@lru_cache(maxsize=1)
+def _base_table() -> Tuple[Tuple[_Pt, ...], ...]:
+    """Fixed-base window table: TB[w][d] = [d * 16^w]B for d in 0..15.
+
+    Makes a base-point multiply 64 additions instead of ~256 doubles +
+    ~128 adds — signing is the fallback's hot path (every envelope and
+    every MultiGrant a replica issues goes through it)."""
+    table = []
+    step = _BASE
+    for _ in range(64):
+        row = [_IDENT]
+        for _ in range(15):
+            row.append(_pt_add(row[-1], step))
+        table.append(tuple(row))
+        step = _pt_add(row[8], row[8])  # 16 * step
+    return tuple(table)
+
+
+def _mul_base(k: int) -> _Pt:
+    acc = _IDENT
+    for w, row in enumerate(_base_table()):
+        digit = (k >> (4 * w)) & 15
+        acc = _pt_add(acc, row[digit])
+    return acc
+
+
+def _compress(p: _Pt) -> bytes:
+    x, y, z, _ = p
+    zinv = pow(z, _P - 2, _P)
+    x = x * zinv % _P
+    y = y * zinv % _P
+    return (y | ((x & 1) << 255)).to_bytes(32, "little")
+
+
+def _decompress(b: bytes) -> Optional[_Pt]:
+    """RFC 8032 §5.1.3 point decoding — ``curve.decompress`` on ints.
+    Returns None for non-points (callers already rejected y >= p)."""
+    if len(b) != 32:
+        return None
+    enc = int.from_bytes(b, "little")
+    sign = enc >> 255
+    y = enc & ((1 << 255) - 1)
+    if y >= _P:
+        return None
+    yy = y * y % _P
+    u = (yy - 1) % _P
+    v = (D_INT * yy + 1) % _P
+    # candidate root x = u * v^3 * (u*v^7)^((p-5)/8)
+    x = u * pow(v, 3, _P) % _P * pow(u * pow(v, 7, _P) % _P, (_P - 5) // 8, _P) % _P
+    vxx = v * x % _P * x % _P
+    if vxx == u:
+        pass
+    elif u != 0 and vxx == _P - u:
+        x = x * SQRT_M1_INT % _P
+    else:
+        return None
+    if x == 0 and sign:
+        return None
+    if (x & 1) != sign:
+        x = _P - x
+    return (x, y, 1, x * y % _P)
+
+
+def _pt_eq(p: _Pt, q: _Pt) -> bool:
+    """Projective equality: X1*Z2 == X2*Z1 and Y1*Z2 == Y2*Z1."""
+    x1, y1, z1, _ = p
+    x2, y2, z2, _ = q
+    return (x1 * z2 - x2 * z1) % _P == 0 and (y1 * z2 - y2 * z1) % _P == 0
+
+
+# ------------------------------------------------------------------ Ed25519
+
+
+@lru_cache(maxsize=4096)
+def _expand_seed(seed: bytes) -> Tuple[int, bytes, bytes]:
+    """RFC 8032 §5.1.5 key expansion -> (clamped scalar, prefix, public).
+    Cached for the same reason keys.py caches OpenSSL key handles: a node
+    signs thousands of messages under the same few seeds."""
+    if len(seed) != 32:
+        # contract parity with Ed25519PrivateKey.from_private_bytes
+        raise ValueError("An Ed25519 private key is 32 bytes long")
+    h = hashlib.sha512(seed).digest()
+    a = int.from_bytes(h[:32], "little")
+    a &= (1 << 254) - 8
+    a |= 1 << 254
+    return a, h[32:], _compress(_mul_base(a))
+
+
+def public_from_seed(seed: bytes) -> bytes:
+    return _expand_seed(bytes(seed))[2]
+
+
+def sign(private_seed: bytes, message: bytes) -> bytes:
+    """RFC 8032 §5.1.6 — bit-compatible with OpenSSL's deterministic sign
+    (the replica's own-grant re-sign-and-compare depends on determinism)."""
+    a, prefix, pub = _expand_seed(bytes(private_seed))
+    r = int.from_bytes(hashlib.sha512(prefix + message).digest(), "little") % _L
+    r_bytes = _compress(_mul_base(r))
+    k = int.from_bytes(
+        hashlib.sha512(r_bytes + pub + message).digest(), "little"
+    ) % _L
+    s = (r + k * a) % _L
+    return r_bytes + s.to_bytes(32, "little")
+
+
+# Verification is a pure function of its three byte inputs, so memoizing is
+# sound — and in-process clusters (testing/virtual_cluster.py) make it pay
+# hard: all rf replicas share one interpreter and each independently checks
+# the SAME 2f+1 certificate grants per Write2, so the cache turns rf*(2f+1)
+# multi-millisecond pure-Python verifies into (2f+1) plus dict hits.
+# Cached on (key, sig, H(R||A||M)) rather than the message itself so the
+# cache holds 160 bytes per entry, not arbitrarily large envelope bodies;
+# the SHA-512 recomputed per call is noise next to the EC math it skips.
+@lru_cache(maxsize=4096)
+def _verify_cached(public_key: bytes, signature: bytes, h_digest: bytes) -> bool:
+    a_point = _decompress(public_key)
+    r_point = _decompress(signature[:32])
+    if a_point is None or r_point is None:
+        return False
+    s = int.from_bytes(signature[32:], "little")
+    h = int.from_bytes(h_digest, "little") % _L
+    return _pt_eq(_mul_base(s), _pt_add(r_point, _scalar_mul(h, a_point)))
+
+
+def verify(public_key: bytes, message: bytes, signature: bytes) -> bool:
+    """Cofactorless ``[S]B == R + [h]A``.  Callers (``keys.verify``) have
+    already enforced canonical encodings (y < p, S < L, lengths)."""
+    public_key = bytes(public_key)
+    signature = bytes(signature)
+    h_digest = hashlib.sha512(signature[:32] + public_key + bytes(message)).digest()
+    return _verify_cached(public_key, signature, h_digest)
+
+
+# ------------------------------------------------------------------ X25519
+
+_A24 = 121665
+
+
+def x25519(private_bytes: bytes, peer_public: bytes) -> bytes:
+    """RFC 7748 §5 X25519 on Python ints (Montgomery ladder).
+
+    Same contract as ``X25519PrivateKey.exchange``: raises ``ValueError``
+    on an all-zero shared secret (small-order peer point) and on
+    wrong-length key material — a mixed cluster must reject the same
+    malformed handshake bytes on both backends."""
+    if len(private_bytes) != 32:
+        raise ValueError("An X25519 private key is 32 bytes long")
+    if len(peer_public) != 32:
+        raise ValueError("An X25519 public key is 32 bytes long")
+    k = int.from_bytes(bytes(private_bytes), "little")
+    k &= (1 << 254) - 8
+    k |= 1 << 254
+    u = int.from_bytes(bytes(peer_public), "little") & ((1 << 255) - 1)
+    x1, x2, z2, x3, z3 = u, 1, 0, u, 1
+    swap = 0
+    for t in reversed(range(255)):
+        k_t = (k >> t) & 1
+        swap ^= k_t
+        if swap:
+            x2, x3 = x3, x2
+            z2, z3 = z3, z2
+        swap = k_t
+        a = (x2 + z2) % _P
+        aa = a * a % _P
+        b = (x2 - z2) % _P
+        bb = b * b % _P
+        e = (aa - bb) % _P
+        c = (x3 + z3) % _P
+        d = (x3 - z3) % _P
+        da = d * a % _P
+        cb = c * b % _P
+        x3 = (da + cb) % _P
+        x3 = x3 * x3 % _P
+        z3 = (da - cb) % _P
+        z3 = z3 * z3 % _P * x1 % _P
+        x2 = aa * bb % _P
+        z2 = e * (aa + _A24 * e) % _P
+    if swap:
+        x2, x3 = x3, x2
+        z2, z3 = z3, z2
+    shared = x2 * pow(z2, _P - 2, _P) % _P
+    if shared == 0:
+        raise ValueError("X25519 shared secret is all zeros (small-order point)")
+    return shared.to_bytes(32, "little")
+
+
+def x25519_public(private_bytes: bytes) -> bytes:
+    """Public key = X25519(private, 9) — the Montgomery basepoint."""
+    return x25519(private_bytes, (9).to_bytes(32, "little"))

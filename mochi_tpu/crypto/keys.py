@@ -7,6 +7,14 @@ clients hold Ed25519 keypairs; signing and the default CPU verify path use the
 host ``cryptography`` library (OpenSSL) — the "BouncyCastle analog" of
 BASELINE.json — while the TPU batch-verify path lives in
 :mod:`mochi_tpu.crypto.batch_verify`.
+
+``cryptography`` is optional: on a bare ``numpy+jax+pytest`` environment the
+import below fails soft and every operation routes to the pure-Python
+fallback (:mod:`mochi_tpu.crypto.hostfallback`, built on the repo's own
+curve arithmetic).  Verdicts are identical either way — strict canonical
+prechecks here, then the cofactorless check — so mixed clusters agree on
+every signature.  The fallback import is deferred to first use: with OpenSSL
+present it never loads (and never pays the JAX import it pulls in).
 """
 
 from __future__ import annotations
@@ -14,17 +22,35 @@ from __future__ import annotations
 from dataclasses import dataclass
 from functools import lru_cache
 
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-    Ed25519PrivateKey,
-    Ed25519PublicKey,
-)
-from cryptography.hazmat.primitives.serialization import (
-    Encoding,
-    NoEncryption,
-    PrivateFormat,
-    PublicFormat,
-)
+try:  # optional accelerator; see module docstring
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+        Ed25519PublicKey,
+    )
+    from cryptography.hazmat.primitives.serialization import (
+        Encoding,
+        NoEncryption,
+        PrivateFormat,
+        PublicFormat,
+    )
+
+    _HAVE_HOST_CRYPTO = True
+except ImportError:  # pragma: no cover - exercised on bare environments
+    import logging
+
+    _HAVE_HOST_CRYPTO = False
+    logging.getLogger(__name__).warning(
+        "cryptography (OpenSSL) not installed: Ed25519/X25519 use the "
+        "pure-Python fallback (~100x slower, variable-time). Production "
+        "deployments should `pip install mochi-tpu[host-crypto]`."
+    )
+
+
+def _fallback():
+    from . import hostfallback
+
+    return hostfallback
 
 
 @dataclass(frozen=True)
@@ -39,6 +65,11 @@ class KeyPair:
 
 
 def generate_keypair() -> KeyPair:
+    if not _HAVE_HOST_CRYPTO:
+        import os
+
+        seed = os.urandom(32)
+        return KeyPair(seed, _fallback().public_from_seed(seed))
     priv = Ed25519PrivateKey.generate()
     seed = priv.private_bytes(Encoding.Raw, PrivateFormat.Raw, NoEncryption())
     pub = priv.public_key().public_bytes(Encoding.Raw, PublicFormat.Raw)
@@ -46,6 +77,8 @@ def generate_keypair() -> KeyPair:
 
 
 def keypair_from_seed(seed: bytes) -> KeyPair:
+    if not _HAVE_HOST_CRYPTO:
+        return KeyPair(seed, _fallback().public_from_seed(seed))
     priv = Ed25519PrivateKey.from_private_bytes(seed)
     pub = priv.public_key().public_bytes(Encoding.Raw, PublicFormat.Raw)
     return KeyPair(seed, pub)
@@ -55,16 +88,18 @@ def keypair_from_seed(seed: bytes) -> KeyPair:
 # itself; replicas/clients reuse the same few keys for every message, so the
 # parsed handles are cached (bounded: a cluster touches n_servers + clients).
 @lru_cache(maxsize=4096)
-def _private_key(private_seed: bytes) -> Ed25519PrivateKey:
+def _private_key(private_seed: bytes) -> "Ed25519PrivateKey":
     return Ed25519PrivateKey.from_private_bytes(private_seed)
 
 
 @lru_cache(maxsize=65536)
-def _public_key(public_key: bytes) -> Ed25519PublicKey:
+def _public_key(public_key: bytes) -> "Ed25519PublicKey":
     return Ed25519PublicKey.from_public_bytes(public_key)
 
 
 def sign(private_seed: bytes, message: bytes) -> bytes:
+    if not _HAVE_HOST_CRYPTO:
+        return _fallback().sign(private_seed, message)
     return _private_key(private_seed).sign(message)
 
 
@@ -91,10 +126,13 @@ def verify(public_key: bytes, message: bytes, signature: bytes) -> bool:
 
     Verdict is bit-for-bit identical to the TPU batch path
     (:mod:`mochi_tpu.crypto.batch_verify`): strict canonical-encoding
-    prechecks, then OpenSSL's cofactorless check.
+    prechecks, then the cofactorless check (OpenSSL, or the pure-Python
+    fallback when ``cryptography`` is absent).
     """
     if not _canonical(public_key, signature):
         return False
+    if not _HAVE_HOST_CRYPTO:
+        return _fallback().verify(public_key, message, signature)
     try:
         _public_key(public_key).verify(signature, message)
         return True

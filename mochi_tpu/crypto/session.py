@@ -33,25 +33,41 @@ import hmac
 import os
 from dataclasses import dataclass
 
-from cryptography.hazmat.primitives.asymmetric.x25519 import (
-    X25519PrivateKey,
-    X25519PublicKey,
-)
-from cryptography.hazmat.primitives.serialization import Encoding, PublicFormat
+try:  # optional accelerator — see crypto/keys.py; fallback is pure Python
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey,
+        X25519PublicKey,
+    )
+    from cryptography.hazmat.primitives.serialization import Encoding, PublicFormat
+
+    _HAVE_HOST_CRYPTO = True
+except ImportError:  # pragma: no cover - exercised on bare environments
+    _HAVE_HOST_CRYPTO = False
 
 _INFO = b"mochi.session.v1"
 
 
 @dataclass(frozen=True)
 class Handshake:
-    """One side's ephemeral handshake state."""
+    """One side's ephemeral handshake state.
 
-    private: X25519PrivateKey
+    ``private`` is an ``X25519PrivateKey`` handle when OpenSSL is available,
+    or the raw 32-byte scalar when running on the pure-Python fallback —
+    :func:`derive_key` dispatches on the type, so mixed clusters (one side
+    with OpenSSL, one without) derive the same session key.
+    """
+
+    private: object
     public_bytes: bytes
     nonce: bytes
 
 
 def new_handshake() -> Handshake:
+    if not _HAVE_HOST_CRYPTO:
+        from . import hostfallback
+
+        seed = os.urandom(32)
+        return Handshake(seed, hostfallback.x25519_public(seed), os.urandom(16))
     priv = X25519PrivateKey.generate()
     pub = priv.public_key().public_bytes(Encoding.Raw, PublicFormat.Raw)
     return Handshake(priv, pub, os.urandom(16))
@@ -66,7 +82,12 @@ def derive_key(
     initiated: bool,
 ) -> bytes:
     """Both sides call this with the SAME (initiator, responder) ordering."""
-    shared = hs.private.exchange(X25519PublicKey.from_public_bytes(peer_public))
+    if isinstance(hs.private, (bytes, bytearray)):
+        from . import hostfallback
+
+        shared = hostfallback.x25519(hs.private, peer_public)
+    else:
+        shared = hs.private.exchange(X25519PublicKey.from_public_bytes(peer_public))
     if initiated:
         nonces = hs.nonce + peer_nonce
     else:

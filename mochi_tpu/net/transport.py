@@ -195,6 +195,8 @@ class _RpcServerProtocol(_FramedProtocol):
     async def _handle_async(self, env: Envelope) -> None:
         try:
             response = await self.server.handler(env)
+        except asyncio.CancelledError:
+            raise  # connection_lost cancels us; don't treat it as a handler bug
         except Exception:
             # The reference swallows handler exceptions and sends nothing,
             # hanging the client future (RequestHandlerDispatcher.java:63-83).

@@ -19,6 +19,7 @@ datastore.  Flow per inbound envelope:
 from __future__ import annotations
 
 import asyncio
+import hmac
 import logging
 import random
 import time
@@ -206,6 +207,8 @@ class MochiReplica:
                 )
                 await self._snapshot_write_fut
                 self.metrics.mark("replica.snapshots")
+            except asyncio.CancelledError:
+                raise  # close() cancelled us mid-write; the final snapshot follows
             except Exception:
                 LOG.exception("periodic snapshot failed")
 
@@ -214,7 +217,9 @@ class MochiReplica:
             self._lag_task.cancel()
             try:
                 await self._lag_task
-            except (asyncio.CancelledError, Exception):
+            except asyncio.CancelledError:
+                pass  # the cancellation we just requested
+            except Exception:
                 pass
             self._lag_task = None
         if self._snapshot_task is not None:
@@ -224,12 +229,16 @@ class MochiReplica:
             self._snapshot_task.cancel()
             try:
                 await self._snapshot_task
-            except (asyncio.CancelledError, Exception):
+            except asyncio.CancelledError:
+                pass  # the cancellation we just requested
+            except Exception:
                 pass
             fut = self._snapshot_write_fut
             if fut is not None and not fut.done():
                 try:
                     await fut
+                except asyncio.CancelledError:
+                    raise
                 except Exception:
                     pass
         for task in list(self._sync_tasks):
@@ -563,6 +572,8 @@ class MochiReplica:
             try:
                 # "*" = full resync (post-reconfiguration ownership changes)
                 await self.resync(None if "*" in batch else batch)
+            except asyncio.CancelledError:
+                raise  # close() cancels sync workers; exit, don't keep draining
             except Exception:
                 LOG.exception("background resync failed")
 
@@ -613,6 +624,8 @@ class MochiReplica:
                     res = await self.peer_pool.send_and_receive(
                         info, self._signed_request(request), timeout_s
                     )
+                except asyncio.CancelledError:
+                    raise
                 except Exception:
                     return
                 if not isinstance(res.payload, SyncEntriesFromServer):
@@ -693,7 +706,7 @@ class MochiReplica:
                     cached = self._own_grant_sigs.get(sb)
                     if cached is None:
                         cached = self.keypair.sign(sb)
-                    valid[i] = cached == mg.signature
+                    valid[i] = hmac.compare_digest(cached, mg.signature)
                 items.append(None)
                 continue
             items.append(VerifyItem(key, mg.signing_bytes(), mg.signature))

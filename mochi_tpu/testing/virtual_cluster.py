@@ -14,6 +14,7 @@ preserved via ``MOCHI_CLUSTER_CONFIG`` pointing at a properties/JSON file.
 
 from __future__ import annotations
 
+import asyncio
 import os
 from typing import Callable, Dict, List, Optional
 
@@ -36,7 +37,15 @@ class VirtualCluster:
         verifier_factory: Optional[Callable[[], SignatureVerifier]] = None,
         require_client_auth: bool = False,
         host: str = "127.0.0.1",
-        shed_lag_ms: float = 30.0,
+        # Lag-based admission control is OFF in-process (real servers keep
+        # the 30 ms default): all rf replicas share one event loop, where
+        # first-use JAX compiles and (without the `cryptography` wheel)
+        # multi-ms pure-Python signature checks stall *everyone* — the lag
+        # monitor then sheds Write1s in response to the harness, not the
+        # system, and tests driving raw envelopes fail OVERLOADED at
+        # random.  test_backpressure pins ``_shed_p`` directly, which works
+        # without the monitor.
+        shed_lag_ms: float = 0.0,
         uds_dir: Optional[str] = None,
     ):
         self.n_servers = n_servers
@@ -66,8 +75,12 @@ class VirtualCluster:
     async def start(self) -> "VirtualCluster":
         if self._external:
             path = os.environ[EXTERNAL_CONFIG_ENV]
-            with open(path) as fh:
-                text = fh.read()
+
+            def _read() -> str:
+                with open(path) as fh:
+                    return fh.read()
+
+            text = await asyncio.get_running_loop().run_in_executor(None, _read)
             self.config = (
                 ClusterConfig.from_json(text)
                 if text.lstrip().startswith("{")
@@ -161,9 +174,12 @@ class VirtualCluster:
         self.replicas.clear()
         self._clients.clear()
         if self._owns_uds_dir and self.uds_dir is not None:
+            import functools
             import shutil
 
-            shutil.rmtree(self.uds_dir, ignore_errors=True)
+            await asyncio.get_running_loop().run_in_executor(
+                None, functools.partial(shutil.rmtree, self.uds_dir, ignore_errors=True)
+            )
             self.uds_dir = None
 
     async def __aenter__(self) -> "VirtualCluster":

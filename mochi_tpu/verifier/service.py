@@ -245,6 +245,8 @@ class RemoteVerifier(SignatureVerifier):
                 raise ValueError("malformed verifier response")
             self.remote_batches += 1
             return [bool(b) for b in payload.bitmap]
+        except asyncio.CancelledError:
+            raise
         except Exception:
             LOG.exception("remote verify failed; falling back to CPU")
             self.fallback_batches += 1

@@ -51,8 +51,12 @@ class CpuVerifier(SignatureVerifier):
     """Inline host verification (the reference-analog CPU path)."""
 
     async def verify_batch(self, items: Sequence[VerifyItem]) -> List[bool]:
+        # Deliberately inline on the loop: this IS the metered host path the
+        # batching/remote verifiers fall back to, and shipping single-item
+        # batches to an executor costs more than the ~120 us verify itself.
         return [
-            crypto_keys.verify(it.public_key, it.message, it.signature) for it in items
+            crypto_keys.verify(it.public_key, it.message, it.signature)  # mochi-lint: disable=async-blocking
+            for it in items
         ]
 
 
@@ -125,6 +129,8 @@ class CoalescingVerifier(SignatureVerifier):
                 bitmap = await self.inner.verify_batch(items)
                 if len(bitmap) != len(items):
                     raise ValueError("inner bitmap length mismatch")
+            except asyncio.CancelledError:
+                raise
             except Exception as exc:
                 # Propagate to the callers of THIS chunk (same behavior as
                 # calling the inner verifier bare); other chunks still run.
@@ -143,6 +149,12 @@ class CoalescingVerifier(SignatureVerifier):
         if self._flush_task is not None and not self._flush_task.done():
             try:
                 await self._flush_task
+            except asyncio.CancelledError:
+                # close() did NOT cancel the flusher (it drains it), so a
+                # CancelledError here is close() itself being cancelled —
+                # propagate, or a wait_for(close(), t) timeout would hang on
+                # the gather below.
+                raise
             except Exception:
                 pass
         if self._chunk_tasks:
@@ -354,6 +366,8 @@ class BatchingVerifier(SignatureVerifier):
             bitmap = await loop.run_in_executor(None, lambda: list(self.backend(items)))
             if len(bitmap) != len(items):
                 raise ValueError("backend bitmap length mismatch")
+        except asyncio.CancelledError:
+            raise
         except Exception:
             LOG.exception("batch backend failed; falling back to CPU verify")
             bitmap = await self.fallback.verify_batch(items)
@@ -371,7 +385,9 @@ class BatchingVerifier(SignatureVerifier):
             self._flusher.cancel()
             try:
                 await self._flusher
-            except (asyncio.CancelledError, Exception):
+            except asyncio.CancelledError:
+                pass  # the cancellation we just requested
+            except Exception:
                 pass
         # Let in-flight chunks finish so their futures resolve (their
         # backend work is already running in the executor either way).

@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# Project-native static analysis over the production tree (docs/ANALYSIS.md).
+# Exit 0 = clean; exit 1 = new findings (fix them or add a justified
+# `# mochi-lint: disable=<rule>` suppression — do NOT re-baseline).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python -m mochi_tpu.analysis mochi_tpu/ scripts/

@@ -19,7 +19,38 @@ import sys
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def lint_gate() -> None:
+    """Refuse to evaluate benchmark rules on a dirty tree.
+
+    A measurement taken on a tree with a known static-analysis finding (a
+    blocking call on the loop, a host sync in traced code) is a measurement
+    of the *bug*, not the system — DSig (arXiv:2406.07215) shows exactly
+    these signature-path micro-regressions dominating BFT tail latency.
+    Same pass as scripts/lint.sh / tier-1 (docs/ANALYSIS.md); escape hatch
+    for forensic re-runs: MOCHI_SKIP_LINT=1.
+    """
+    if os.environ.get("MOCHI_SKIP_LINT"):
+        return
+    sys.path.insert(0, _REPO)
+    from mochi_tpu.analysis import core as analysis_core
+
+    result = analysis_core.run(
+        [os.path.join(_REPO, "mochi_tpu"), os.path.join(_REPO, "scripts")],
+        baseline=os.path.join(_REPO, "config", "analysis_baseline.json"),
+    )
+    if not result.clean:
+        for finding in result.new:
+            print(" !", finding.render())
+        print(
+            f"refusing to evaluate standing rules: {len(result.new)} static-"
+            "analysis finding(s) on the tree (scripts/lint.sh; "
+            "MOCHI_SKIP_LINT=1 overrides)"
+        )
+        raise SystemExit(1)
+
+
 def main() -> None:
+    lint_gate()
     round_n = sys.argv[1] if len(sys.argv) > 1 else "05"
     path = os.path.join(_REPO, "benchmarks", f"results_r{round_n}_tpu.json")
     if not os.path.exists(path):

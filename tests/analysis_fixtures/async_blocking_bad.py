@@ -1,0 +1,13 @@
+"""Seeded regression fixture: every call here must trip async-blocking."""
+
+import time
+import subprocess
+from mochi_tpu.crypto import keys
+
+
+async def handler(seed, msg):
+    time.sleep(0.1)  # blocking sleep on the loop
+    with open("/tmp/x") as fh:  # blocking builtin IO
+        fh.read()
+    subprocess.run(["true"])  # blocking subprocess
+    return keys.sign(seed, msg)  # host crypto on the loop

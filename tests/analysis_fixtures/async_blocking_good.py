@@ -1,0 +1,24 @@
+"""Counterpart fixture: none of these may trip async-blocking."""
+
+import asyncio
+import time
+from mochi_tpu.crypto import keys
+
+
+def sync_helper(seed, msg):
+    # blocking calls in a SYNC function are fine (executor fodder)
+    time.sleep(0.1)
+    with open("/tmp/x") as fh:
+        fh.read()
+    return keys.sign(seed, msg)
+
+
+async def handler(seed, msg):
+    await asyncio.sleep(0.1)  # the async equivalent
+
+    def _work():
+        time.sleep(0.1)  # nested sync def: shipped to the executor
+        return keys.sign(seed, msg)
+
+    loop = asyncio.get_running_loop()
+    return await loop.run_in_executor(None, _work)

@@ -1,0 +1,33 @@
+"""Seeded regression fixture: every handler here must trip
+cancellation-hygiene."""
+
+import asyncio
+
+
+async def bare_except():
+    try:
+        await asyncio.sleep(1)
+    except:  # noqa: E722 - deliberately bare
+        pass
+
+
+async def base_exception():
+    try:
+        await asyncio.sleep(1)
+    except BaseException:
+        pass
+
+
+async def tuple_swallow(task):
+    task.cancel()
+    try:
+        await task
+    except (asyncio.CancelledError, Exception):
+        pass
+
+
+async def broad_no_cancel_sibling():
+    try:
+        await asyncio.sleep(1)
+    except Exception:
+        pass

@@ -1,0 +1,44 @@
+"""Counterpart fixture: none of these may trip cancellation-hygiene."""
+
+import asyncio
+
+
+async def reraises():
+    try:
+        await asyncio.sleep(1)
+    except Exception:
+        raise
+
+
+async def explicit_cancel_sibling():
+    try:
+        await asyncio.sleep(1)
+    except asyncio.CancelledError:
+        raise
+    except Exception:
+        pass
+
+
+async def await_cancelled_task(task):
+    task.cancel()
+    try:
+        await task
+    except asyncio.CancelledError:
+        pass  # the cancellation we just requested
+    except Exception:
+        pass
+
+
+async def no_await_in_try():
+    try:
+        x = 1 / 0  # nothing awaitable: cancellation can't originate here
+    except Exception:
+        x = 0
+    await asyncio.sleep(x)
+
+
+def sync_function():
+    try:
+        pass
+    except Exception:
+        pass

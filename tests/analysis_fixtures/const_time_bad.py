@@ -1,0 +1,22 @@
+"""Seeded regression fixture: every site here must trip constant-time.
+(Checked with the path filter off — fixtures live under tests/.)"""
+
+
+def check_sig(expected_signature: bytes, signature: bytes) -> bool:
+    return signature == expected_signature  # timing oracle
+
+
+def check_mac(mac: bytes, computed_mac: bytes) -> bool:
+    if mac != computed_mac:  # timing oracle
+        return False
+    return True
+
+
+def check_digest(digest: bytes, other) -> bool:
+    return other.digest == digest  # attribute operand, same oracle
+
+
+def secret_early_return(private_seed: bytes, message: bytes) -> bytes:
+    if private_seed[0] & 1:  # secret-dependent early return
+        return message
+    return message + b"\x00"

@@ -1,0 +1,26 @@
+"""Counterpart fixture: none of these may trip constant-time."""
+
+import hmac
+
+from mochi_tpu.protocol.messages import FailType
+
+
+def check_sig(expected_signature: bytes, signature: bytes) -> bool:
+    return hmac.compare_digest(signature, expected_signature)
+
+
+def sig_presence(signature) -> bool:
+    # identity/None checks carry no byte content to leak
+    return signature is not None
+
+
+def enum_compare(fail_type) -> bool:
+    # ALL-CAPS chain = constant, not authenticator bytes
+    return fail_type == FailType.BAD_SIGNATURE
+
+
+def public_branch(message: bytes, signature: bytes) -> bytes:
+    # branching on PUBLIC length is not a secret-dependent return
+    if len(message) > 64:
+        return message[:64]
+    return message

@@ -1,0 +1,22 @@
+"""Seeded regression fixture: both rules of protocol-invariants must trip."""
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class PingToServer:
+    message: str = "ping"
+
+
+@dataclass(frozen=True)
+class ForgottenFromServer:  # defined but NOT registered below
+    message: str = "oops"
+
+
+_PAYLOAD_TYPES = (
+    PingToServer,
+)
+
+
+def quorum_of(f: int) -> int:
+    return 2 * f + 1  # inline quorum arithmetic

@@ -1,0 +1,31 @@
+"""Counterpart fixture: none of these may trip protocol-invariants."""
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class PingToServer:
+    message: str = "ping"
+
+
+@dataclass(frozen=True)
+class PongFromServer:
+    message: str = "pong"
+
+
+class GrantHelper:  # not a message class: suffix doesn't match
+    pass
+
+
+_PAYLOAD_TYPES = (
+    PingToServer,
+    PongFromServer,
+)
+
+
+def quorum_of(config) -> int:
+    return config.quorum  # the single source of BFT math
+
+
+def unrelated_arithmetic(n: int) -> int:
+    return 2 * n + 1  # not the quorum shape: operand is not `f`

@@ -1,0 +1,17 @@
+"""Fixture for suppression-comment behavior: two identical violations, one
+suppressed inline, one suppressed by the preceding line, one live."""
+
+import time
+
+
+async def suppressed_inline():
+    time.sleep(0.1)  # mochi-lint: disable=async-blocking
+
+
+async def suppressed_above():
+    # mochi-lint: disable=async-blocking
+    time.sleep(0.1)
+
+
+async def live_violation():
+    time.sleep(0.1)

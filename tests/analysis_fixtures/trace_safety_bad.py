@@ -1,0 +1,31 @@
+"""Seeded regression fixture: every site here must trip jax-trace-safety.
+(Checked with the path filter off — fixtures live under tests/.)"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def branch_on_traced(x: jnp.ndarray) -> jnp.ndarray:
+    if x > 0:  # Python branch on a traced value
+        return x
+    return -x
+
+
+def host_sync(x: jnp.ndarray) -> float:
+    return float(x)  # blocking device->host transfer
+
+
+def item_sync(x: jnp.ndarray):
+    return x.item()  # blocking device->host transfer
+
+
+def numpy_host_op(x: jnp.ndarray):
+    return np.sum(x)  # silently drops out of the traced program
+
+
+@jax.jit
+def jitted_unannotated(x):
+    while x < 3:  # Python loop on a tracer
+        x = x + 1
+    return x

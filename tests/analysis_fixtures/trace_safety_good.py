@@ -1,0 +1,37 @@
+"""Counterpart fixture: none of these may trip jax-trace-safety."""
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def shape_branch(x: jnp.ndarray) -> jnp.ndarray:
+    # static-shape branching selects kernel variants — exempt
+    if len(x.shape) == 2:
+        return x
+    if x.dtype == jnp.int32:
+        return x
+    for i in range(x.shape[0]):
+        x = x + i
+    return x
+
+
+def branchless(x: jnp.ndarray) -> jnp.ndarray:
+    return jnp.where(x > 0, x, -x)
+
+
+def host_helper(limbs) -> int:
+    # un-annotated host-side helper: numpy/float are its whole job
+    arr = np.asarray(limbs)
+    return int(arr[0])
+
+
+@functools.partial(jax.jit, static_argnames=("flag",))
+def static_arg_branch(x, flag: bool = False):
+    # `flag` is declared static: Python branching on it is the idiom
+    if flag:
+        return lax.neg(x)
+    return x

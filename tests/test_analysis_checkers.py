@@ -1,0 +1,238 @@
+"""Per-checker tests for mochi_tpu.analysis, driven by good/bad fixture
+pairs under tests/analysis_fixtures/ (the bad file of each pair is also the
+seeded-regression corpus tests/test_static_analysis.py runs through the
+CLI)."""
+
+import os
+
+import pytest
+
+from mochi_tpu.analysis import core
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "analysis_fixtures")
+
+
+def fixture(name: str) -> str:
+    return os.path.join(FIXTURES, name)
+
+
+def run_rule(rule: str, filename: str) -> core.RunResult:
+    # scoped=False: fixtures live under tests/, outside the production path
+    # scopes (e.g. trace-safety only looks at crypto/ + parallel/).
+    return core.run([fixture(filename)], rules=[rule], scoped=False)
+
+
+BAD_EXPECTATIONS = [
+    ("async-blocking", "async_blocking_bad.py", 4),
+    ("cancellation-hygiene", "cancellation_bad.py", 4),
+    ("jax-trace-safety", "trace_safety_bad.py", 5),
+    ("constant-time", "const_time_bad.py", 4),
+    ("protocol-invariants", "invariants_bad.py", 2),
+]
+
+
+@pytest.mark.parametrize("rule,filename,expected", BAD_EXPECTATIONS)
+def test_bad_fixture_trips_checker(rule, filename, expected):
+    result = run_rule(rule, filename)
+    lines = sorted(f.line for f in result.new)
+    assert len(result.new) == expected, (
+        f"{filename}: expected {expected} findings, got "
+        f"{[f.render() for f in result.new]}"
+    )
+    assert all(f.rule == rule for f in result.new)
+    assert len(set(lines)) == expected, "each seeded site flags exactly once"
+
+
+@pytest.mark.parametrize(
+    "rule,filename",
+    [
+        ("async-blocking", "async_blocking_good.py"),
+        ("cancellation-hygiene", "cancellation_good.py"),
+        ("jax-trace-safety", "trace_safety_good.py"),
+        ("constant-time", "const_time_good.py"),
+        ("protocol-invariants", "invariants_good.py"),
+    ],
+)
+def test_good_fixture_is_clean(rule, filename):
+    result = run_rule(rule, filename)
+    assert result.new == [], [f.render() for f in result.new]
+
+
+def test_cross_rule_runs_do_not_bleed():
+    # The cancellation fixture must not trip e.g. constant-time, and running
+    # every rule over a bad fixture still only reports its own rule's sites.
+    result = core.run(
+        [fixture("cancellation_bad.py")], scoped=False
+    )
+    assert {f.rule for f in result.new} == {"cancellation-hygiene"}
+
+
+# ------------------------------------------------------------- suppressions
+
+
+def test_suppression_same_line_and_line_above():
+    result = core.run([fixture("suppression_fixture.py")], scoped=False)
+    assert len(result.new) == 1, [f.render() for f in result.new]
+    assert len(result.suppressed) == 2
+    # the live finding is the `time.sleep` inside live_violation(), the
+    # un-commented third coroutine — not either suppressed site
+    src_lines = open(fixture("suppression_fixture.py")).read().splitlines()
+    live_def = next(
+        i for i, ln in enumerate(src_lines, start=1) if "def live_violation" in ln
+    )
+    assert result.new[0].line > live_def
+    assert result.new[0].snippet == "time.sleep(0.1)"
+    assert all(s.line < live_def for s in result.suppressed)
+
+
+def test_suppression_requires_matching_rule(tmp_path):
+    src = (
+        "import time\n"
+        "async def f():\n"
+        "    time.sleep(1)  # mochi-lint: disable=constant-time\n"
+    )
+    p = tmp_path / "wrong_rule.py"
+    p.write_text(src)
+    result = core.run([str(p)], scoped=False)
+    assert len(result.new) == 1  # suppression names a different rule
+
+    p2 = tmp_path / "all_rule.py"
+    p2.write_text(src.replace("constant-time", "all"))
+    result = core.run([str(p2)], scoped=False)
+    assert result.new == [] and len(result.suppressed) == 1
+
+
+# ----------------------------------------------------------------- baseline
+
+
+def test_baseline_grandfathers_and_ratchets(tmp_path):
+    target = fixture("async_blocking_bad.py")
+    first = core.run([target], scoped=False)
+    assert len(first.new) == 4
+
+    baseline_path = tmp_path / "baseline.json"
+    core.write_baseline(str(baseline_path), first.new)
+
+    second = core.run([target], scoped=False, baseline=str(baseline_path))
+    assert second.new == []
+    assert len(second.baselined) == 4
+
+    # a NEW violation is still caught even with the old ones baselined
+    extra = tmp_path / "extra.py"
+    extra.write_text("import time\nasync def g():\n    time.sleep(2)\n")
+    third = core.run(
+        [target, str(extra)], scoped=False, baseline=str(baseline_path)
+    )
+    assert len(third.new) == 1 and third.new[0].path.endswith("extra.py")
+    assert len(third.baselined) == 4
+
+
+def test_fingerprint_survives_line_drift(tmp_path):
+    a = tmp_path / "a.py"
+    a.write_text("import time\nasync def f():\n    time.sleep(1)\n")
+    fp1 = core.run([str(a)], scoped=False).new[0].fingerprint
+    # prepend unrelated code: the finding moves lines but not content
+    a.write_text("import time\nX = 1\nY = 2\nasync def f():\n    time.sleep(1)\n")
+    fp2 = core.run([str(a)], scoped=False).new[0].fingerprint
+    assert fp1 == fp2
+
+
+# -------------------------------------------------------------- odds & ends
+
+
+def test_raise_in_nested_def_does_not_count_as_reraise(tmp_path):
+    # A handler whose only `raise` lives inside a nested function never
+    # re-raises in the handler itself — it still swallows CancelledError.
+    p = tmp_path / "nested_raise.py"
+    p.write_text(
+        "async def f(ch):\n"
+        "    try:\n"
+        "        await ch.get()\n"
+        "    except BaseException:\n"
+        "        def _log():\n"
+        "            raise RuntimeError('later')\n"
+        "        register(_log)\n"
+    )
+    result = core.run([str(p)], rules=["cancellation-hygiene"], scoped=False)
+    assert len(result.new) == 1, [f.render() for f in result.new]
+
+
+def test_local_name_collision_not_flagged(tmp_path):
+    # A module-local function whose bare name collides with a deny-list
+    # pattern's terminal segment (os.wait, crypto.keys.verify, ...) is NOT a
+    # blocking call — single-segment names only match single-segment patterns.
+    p = tmp_path / "local_names.py"
+    p.write_text(
+        "def wait(handles):\n    return handles\n"
+        "def verify(x):\n    return x\n"
+        "async def f():\n    return wait(verify(1))\n"
+    )
+    result = core.run([str(p)], rules=["async-blocking"], scoped=False)
+    assert result.new == [], [f.render() for f in result.new]
+
+
+def test_fingerprints_stable_across_cwd(tmp_path, monkeypatch):
+    # lint.sh scans from the repo root; standing_rules.py passes absolute
+    # paths from an arbitrary CWD — fingerprints must agree or a non-empty
+    # baseline silently stops matching.
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text("import time\nasync def f():\n    time.sleep(1)\n")
+
+    monkeypatch.chdir(tmp_path)
+    fp_rel = core.run(["pkg"], scoped=False).new[0]
+    monkeypatch.chdir("/")
+    fp_abs = core.run([str(pkg)], scoped=False).new[0]
+    assert fp_rel.path == fp_abs.path == "pkg/mod.py"
+    assert fp_rel.fingerprint == fp_abs.fingerprint
+
+
+def test_single_file_scan_keeps_package_path():
+    # Scanning one file must behave exactly like the directory scan that
+    # contains it: `analysis mochi_tpu/cluster/config.py` once reported a
+    # false-positive protocol-invariants finding (basename display dropped
+    # the cluster/config.py exemption), and `analysis mochi_tpu/crypto/keys.py`
+    # silently skipped the crypto-scoped checkers.
+    import mochi_tpu
+
+    pkg_root = os.path.dirname(os.path.dirname(mochi_tpu.__file__))
+    cfg = os.path.join(pkg_root, "mochi_tpu", "cluster", "config.py")
+    result = core.run([cfg], rules=["protocol-invariants"], scoped=True)
+    assert result.new == [], [f.render() for f in result.new]
+    keys = os.path.join(pkg_root, "mochi_tpu", "crypto", "keys.py")
+    (disp,) = [d for d, _ in core.iter_python_files([keys])]
+    assert disp == "mochi_tpu/crypto/keys.py"
+
+
+def test_identical_snippets_get_distinct_fingerprints(tmp_path):
+    p = tmp_path / "twice.py"
+    p.write_text(
+        "import time\n"
+        "async def f():\n    time.sleep(1)\n"
+        "async def g():\n    time.sleep(1)\n"
+    )
+    result = core.run([str(p)], scoped=False)
+    assert len(result.new) == 2
+    fps = {f.fingerprint for f in result.new}
+    assert len(fps) == 2, "one baseline entry must not grandfather both sites"
+
+
+def test_parse_error_is_a_finding(tmp_path):
+    p = tmp_path / "broken.py"
+    p.write_text("def f(:\n")
+    result = core.run([str(p)], scoped=False)
+    assert len(result.new) == 1 and result.new[0].rule == "parse-error"
+
+
+def test_unknown_rule_rejected():
+    with pytest.raises(ValueError):
+        core.run([FIXTURES], rules=["no-such-rule"])
+
+
+def test_scoping_excludes_fixture_paths():
+    # With default scoping, trace-safety ignores files outside crypto/ and
+    # parallel/ — the reason fixture tests pass scoped=False.
+    result = core.run(
+        [fixture("trace_safety_bad.py")], rules=["jax-trace-safety"], scoped=True
+    )
+    assert result.new == []

@@ -1,0 +1,82 @@
+"""Tier-1 gate: the tree is lint-clean under mochi_tpu.analysis.
+
+Two guarantees, both via the same CLI every future PR runs
+(``scripts/lint.sh``):
+
+1. ``python -m mochi_tpu.analysis mochi_tpu/ scripts/`` exits 0 on the
+   current tree — a new finding anywhere fails this test, so the checkers
+   gate every PR through the existing pytest tier-1 hook;
+2. each of the five seeded regression fixtures (one per checker), dropped
+   into a scanned tree, flips the exit code to non-zero — the checkers
+   can't silently rot into no-ops.
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "analysis_fixtures")
+BASELINE = os.path.join(REPO, "config", "analysis_baseline.json")
+
+
+def run_cli(*args: str, cwd: str = REPO) -> subprocess.CompletedProcess:
+    env = dict(os.environ)
+    # the repo may be run from a checkout without `pip install -e .`
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run(
+        [sys.executable, "-m", "mochi_tpu.analysis", *args],
+        cwd=cwd,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+
+
+def test_tree_is_lint_clean():
+    proc = run_cli("mochi_tpu/", "scripts/")
+    assert proc.returncode == 0, f"new findings:\n{proc.stdout}{proc.stderr}"
+
+
+def test_baseline_file_is_empty():
+    # The shipped baseline grandfathers nothing: every finding on the tree
+    # is fixed or carries an explicit justified suppression.  A PR that
+    # re-baselines instead of fixing turns this red.
+    import json
+
+    with open(BASELINE) as fh:
+        doc = json.load(fh)
+    assert doc["fingerprints"] == []
+
+
+SEEDED = [
+    "async_blocking_bad.py",
+    "cancellation_bad.py",
+    "trace_safety_bad.py",
+    "const_time_bad.py",
+    "invariants_bad.py",
+]
+
+
+@pytest.mark.parametrize("bad_fixture", SEEDED)
+def test_seeded_regression_flips_exit_code(bad_fixture, tmp_path):
+    # Simulate the regression landing in a scanned package: the fixture is
+    # copied into a fresh tree and the CLI must go non-zero on it.
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    shutil.copy(os.path.join(FIXTURES, bad_fixture), pkg / bad_fixture)
+    proc = run_cli(str(pkg), "--no-path-filter", cwd=str(tmp_path))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "[" in proc.stdout  # at least one rendered finding
+
+
+def test_clean_file_exits_zero(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "ok.py").write_text("import asyncio\n\nasync def f():\n    await asyncio.sleep(1)\n")
+    proc = run_cli(str(pkg), "--no-path-filter", cwd=str(tmp_path))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
