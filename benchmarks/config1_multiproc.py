@@ -197,6 +197,10 @@ def run(
         "metric": "signed_txn_throughput_multiproc",
         "value": round(ops / wall, 1),
         "unit": "txns/sec",
+        # Round-4 battery's multiproc record (benchmarks/results_r04.json,
+        # OpenSSL-wheel host) — same caveat as config1_cluster.PRIOR_TXN_S_R04.
+        "prior_txn_s": 367.9,
+        "vs_prior": round(ops / wall / 367.9, 3),
         "topology": f"{n_servers} server procs + verifier proc + client proc, 1 host core",
         "ops": ops,
         "wall_s": round(wall, 2),

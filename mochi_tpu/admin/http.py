@@ -47,6 +47,8 @@ quorum={quorum} &middot; {member}</p>
 <table>{store_rows}</table>
 <h2>Verifier</h2>
 <table>{verifier_rows}</table>
+<h2>Batching</h2>
+<table>{batching_rows}</table>
 <p class="muted">{sessions} live client sessions &middot;
 admin-gated: {admin_gated} &middot; page auto-refreshes</p>
 <ul>
@@ -67,6 +69,23 @@ def _esc(s) -> str:
 def _rows(d: dict) -> str:
     return "".join(
         f"<tr><td>{_esc(k)}</td><td>{_esc(v)}</td></tr>" for k, v in d.items()
+    )
+
+
+def _batching_rows(metrics) -> str:
+    """Occupancy/latency histograms of the batched hot path, one row per
+    histogram: count, mean, and the non-empty buckets — the at-a-glance
+    answer to "is the drain actually batching under this traffic?"
+    (docs/OPERATIONS.md "Batched hot path")."""
+    rows = {}
+    for name, h in sorted(metrics.histograms.items()):
+        snap = h.snapshot()
+        buckets = " ".join(f"&le;{b}:{n}" for b, n in snap["buckets"].items())
+        rows[name] = f"n={snap['count']} mean={snap['mean']} [{buckets}]"
+    if not rows:
+        return "<tr><td>(no batched traffic yet)</td><td></td></tr>"
+    return "".join(
+        f"<tr><td>{_esc(k)}</td><td>{v}</td></tr>" for k, v in rows.items()
     )
 
 
@@ -164,6 +183,10 @@ class AdminServer(HttpJsonServer):
                     },
                     "store": r.store.stats(),
                     "verifier": verifier_stats(r.verifier),
+                    "batching": {
+                        name: h.snapshot()
+                        for name, h in sorted(r.metrics.histograms.items())
+                    },
                     "sessions": len(getattr(r, "_sessions", {})),
                     "config_history_stamps": sorted(r.store.config_history),
                     "member": r.server_id in cfg.servers,
@@ -198,6 +221,7 @@ class AdminServer(HttpJsonServer):
                 member_rows=member_rows,
                 store_rows=_rows(r.store.stats()),
                 verifier_rows=_rows(verifier_stats(r.verifier)),
+                batching_rows=_batching_rows(r.metrics),
                 sessions=len(getattr(r, "_sessions", {})),
                 admin_gated=bool(cfg.admin_keys),
             )

@@ -93,8 +93,11 @@ class MochiDBClient:
     # full replica set; retries widen to the full set.  Off by default: it
     # saves f requests per write but measured SLOWER on the single-core
     # loopback bench (the skipped replica's grant was free parallelism
-    # there); on a real multi-host deployment the saved WAN round trips
-    # should win — measure per deployment.
+    # there) — re-confirmed in the batched-hot-path round even with the
+    # ~650 us pure-Python grant signs, where the trim still lost ~35% of
+    # config-1 throughput to retry widening; on a real multi-host
+    # deployment the saved WAN round trips should win — measure per
+    # deployment.
     trim_write1: bool = False
 
     def __post_init__(self) -> None:

@@ -72,13 +72,57 @@ def _scalar_mul(k: int, p: _Pt) -> _Pt:
 
 @lru_cache(maxsize=1)
 def _base_table() -> Tuple[Tuple[_Pt, ...], ...]:
-    """Fixed-base window table: TB[w][d] = [d * 16^w]B for d in 0..15.
+    """Fixed-base window table: TB[w][d] = [d * 16^w]B for d in 0..15
+    (shared builder: ``_window_table``, defined with the signer comb).
 
-    Makes a base-point multiply 64 additions instead of ~256 doubles +
+    Makes a base-point multiply <=64 additions instead of ~256 doubles +
     ~128 adds — signing is the fallback's hot path (every envelope and
     every MultiGrant a replica issues goes through it)."""
+    return _window_table(_BASE)
+
+
+def _mul_base(k: int) -> _Pt:
+    # NO zero-digit skip here, unlike _mul_signer: base multiplies run with
+    # SECRET scalars (the signing nonce r, the private scalar a), and a
+    # skip would make per-signature timing correlate with the nonce's
+    # zero-nibble count — partial-nonce leakage is a known lattice vector
+    # for key recovery.  The fallback is variable-time at the bignum level
+    # regardless (module docstring), but there is no reason to add a
+    # branch-per-secret-nibble on top for a ~6% saving.  _mul_signer's
+    # skip is verify-only, where k = h is public.
+    acc = _IDENT
+    for w, row in enumerate(_base_table()):
+        acc = _pt_add(acc, row[(k >> (4 * w)) & 15])
+    return acc
+
+
+# ---------------------------------------------------------------- signer comb
+#
+# Per-SIGNER window tables: the host analog of the device comb registry
+# (crypto/comb.py) — a replica set re-verifies the same few cluster
+# identities forever, so the variable-base half of every verify ([h]A, a
+# ~380-addition double-and-add ladder) collapses to <=63 table additions
+# once A's table exists.  Tables are built on a key's SECOND appearance
+# (a counter, not the table, is the first-touch cost), so a one-shot
+# forgery under a random key can never make us pay the ~960-addition
+# build — repeat signers amortize it in two verifies.
+
+_TABLE_PROMOTE_AFTER = 2
+# The promotion tracker is bounded by the TABLE cache size: letting more
+# keys reach promoted state than the LRU can hold would thrash it — cyclic
+# access over more promoted signers than entries rebuilds the table every
+# verify, inverting the comb into a ~2.7x slowdown.  When the tracker
+# fills, it resets and keys re-earn promotion, so only the hot subset ever
+# holds tables.
+_TABLE_CACHE_SIZE = 128
+_seen_signers: dict = {}  # compressed key -> VERIFIED count (bounded)
+
+
+def _window_table(point: _Pt) -> Tuple[Tuple[_Pt, ...], ...]:
+    """TB[w][d] = [d * 16^w]P — the shared 4-bit fixed-window builder
+    behind both the basepoint table and per-signer tables."""
     table = []
-    step = _BASE
+    step = point
     for _ in range(64):
         row = [_IDENT]
         for _ in range(15):
@@ -88,11 +132,25 @@ def _base_table() -> Tuple[Tuple[_Pt, ...], ...]:
     return tuple(table)
 
 
-def _mul_base(k: int) -> _Pt:
+# Cache covers the north-star n=64 membership plus clients/headroom
+# (worst-case memory ~50 MB at ≈400 KB/table, only ever paid on wheel-less
+# fallback hosts); the promotion tracker above guarantees the promoted set
+# never exceeds it.
+@lru_cache(maxsize=_TABLE_CACHE_SIZE)
+def _signer_table(compressed: bytes) -> Optional[Tuple[Tuple[_Pt, ...], ...]]:
+    """Window table for the signer's point A (None: not a point)."""
+    point = _decompress(compressed)
+    if point is None:
+        return None
+    return _window_table(point)
+
+
+def _mul_signer(k: int, table: Tuple[Tuple[_Pt, ...], ...]) -> _Pt:
     acc = _IDENT
-    for w, row in enumerate(_base_table()):
+    for w, row in enumerate(table):
         digit = (k >> (4 * w)) & 15
-        acc = _pt_add(acc, row[digit])
+        if digit:
+            acc = _pt_add(acc, row[digit])
     return acc
 
 
@@ -185,13 +243,32 @@ def sign(private_seed: bytes, message: bytes) -> bytes:
 # the SHA-512 recomputed per call is noise next to the EC math it skips.
 @lru_cache(maxsize=4096)
 def _verify_cached(public_key: bytes, signature: bytes, h_digest: bytes) -> bool:
-    a_point = _decompress(public_key)
     r_point = _decompress(signature[:32])
-    if a_point is None or r_point is None:
+    if r_point is None:
         return False
     s = int.from_bytes(signature[32:], "little")
     h = int.from_bytes(h_digest, "little") % _L
-    return _pt_eq(_mul_base(s), _pt_add(r_point, _scalar_mul(h, a_point)))
+    # Repeat VERIFIED signers (cluster identities) get the windowed comb
+    # table — [h]A in <=63 additions instead of the ~380-addition ladder.
+    # Only successful verifications count toward promotion: forged-
+    # signature floods under rotating bogus keys must neither pay the
+    # ~960-addition table build nor evict legitimate signers' tables.
+    if _seen_signers.get(public_key, 0) >= _TABLE_PROMOTE_AFTER:
+        table = _signer_table(public_key)
+        if table is None:
+            return False
+        ha = _mul_signer(h, table)
+    else:
+        a_point = _decompress(public_key)
+        if a_point is None:
+            return False
+        ha = _scalar_mul(h, a_point)
+    ok = _pt_eq(_mul_base(s), _pt_add(r_point, ha))
+    if ok:
+        if len(_seen_signers) >= _TABLE_CACHE_SIZE and public_key not in _seen_signers:
+            _seen_signers.clear()  # promoted set must fit the table cache
+        _seen_signers[public_key] = _seen_signers.get(public_key, 0) + 1
+    return ok
 
 
 def verify(public_key: bytes, message: bytes, signature: bytes) -> bool:

@@ -21,9 +21,28 @@ import hashlib
 from dataclasses import dataclass, field, replace
 from enum import IntEnum
 from functools import cached_property
-from typing import Any, Dict, Optional, Tuple, Type
+from types import MappingProxyType
+from typing import Any, Dict, Mapping, Optional, Tuple, Type
 
 from .codec import decode, decode_env, encode
+
+
+def _frozen_map(d: "Mapping") -> "Mapping":
+    """Read-only view for a payload's nested dict field.
+
+    Payload dataclasses are ``frozen=True``, but a frozen dataclass only
+    locks its ATTRIBUTES — a dict-valued field stayed mutable, and the
+    envelope layer caches each payload's mcode encoding on the object
+    (``Envelope._six_bytes`` / ``__dict__["_mcode"]``), so one post-
+    construction ``mg.grants[k] = ...`` would silently desync the signing
+    bytes from the object's contents (ADVICE r5).  A ``mappingproxy``
+    makes that mutation raise ``TypeError`` at the mutation site instead.
+    Encoding never sees the proxy (``to_obj`` builds fresh plain dicts);
+    equality against plain dicts is preserved (proxy delegates ``__eq__``).
+    """
+    if isinstance(d, MappingProxyType):
+        return d  # replace()/copy paths re-enter __post_init__; don't re-wrap
+    return MappingProxyType(dict(d))
 
 
 class Action(IntEnum):
@@ -160,6 +179,9 @@ class MultiGrant:
     server_id: str
     signature: Optional[bytes] = None
 
+    def __post_init__(self) -> None:
+        object.__setattr__(self, "grants", _frozen_map(self.grants))
+
     def signing_bytes(self) -> bytes:
         """Canonical bytes covered by the server's signature (excludes the
         signature field itself)."""
@@ -183,7 +205,9 @@ class MultiGrant:
         grants, client_id, server_id, sig = obj
         mg = object.__new__(cls)
         mg.__dict__.update(
-            grants={k: Grant.from_obj(g) for k, g in grants.items()},
+            # decode path bypasses __init__ (and thus __post_init__): wrap
+            # here too, same invariant as constructed instances
+            grants=MappingProxyType({k: Grant.from_obj(g) for k, g in grants.items()}),
             client_id=client_id, server_id=server_id, signature=sig,
         )
         return mg
@@ -195,6 +219,9 @@ class WriteCertificate:
     (ref: ``MochiProtocol.proto:126-130``)."""
 
     grants: Dict[str, MultiGrant]  # server_id -> MultiGrant
+
+    def __post_init__(self) -> None:
+        object.__setattr__(self, "grants", _frozen_map(self.grants))
 
     def to_obj(self) -> Any:
         return {sid: mg.to_obj() for sid, mg in self.grants.items()}
@@ -308,6 +335,11 @@ class Write1OkFromServer:
     multi_grant: MultiGrant
     current_certificates: Dict[str, WriteCertificate] = field(default_factory=dict)
 
+    def __post_init__(self) -> None:
+        object.__setattr__(
+            self, "current_certificates", _frozen_map(self.current_certificates)
+        )
+
     def to_obj(self) -> Any:
         return [self.multi_grant.to_obj(), {k: c.to_obj() for k, c in self.current_certificates.items()}]
 
@@ -325,6 +357,11 @@ class Write1RefusedFromServer:
     multi_grant: MultiGrant  # statuses indicate per-object grant/refusal
     current_certificates: Dict[str, WriteCertificate] = field(default_factory=dict)
     client_id: str = ""
+
+    def __post_init__(self) -> None:
+        object.__setattr__(
+            self, "current_certificates", _frozen_map(self.current_certificates)
+        )
 
     def to_obj(self) -> Any:
         return [

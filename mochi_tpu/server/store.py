@@ -343,6 +343,30 @@ class DataStore:
             return Write1OkFromServer(multi_grant, current_certs)
         return Write1RefusedFromServer(multi_grant, current_certs, req.client_id)
 
+    def process_write1_batch(
+        self, reqs: "Iterable[Write1ToServer]"
+    ) -> "List[Union[Write1Response, BadRequest]]":
+        """Grant issuance for one drained batch in a single store entry.
+
+        The store has no mutex — the replica's event loop is the lock — so
+        the batched analog of "take the lock once per batch" is this: the
+        whole batch issues grants in ONE uninterrupted loop turn (no task
+        switch, no await, no interleaved Write2 between two Write1s of the
+        same drain), paying one call-frame + metrics entry for N requests.
+        Per-request failures return as exception VALUES (``BadRequest`` for
+        validation, anything else for a processing bug) so one bad request
+        cannot poison its batchmates — the caller maps ``BadRequest`` to a
+        typed refusal and drops (logs) the rest, exactly the per-message
+        blast radius the pre-batch dispatch had.
+        """
+        out: List[Union[Write1Response, BadRequest]] = []
+        for req in reqs:
+            try:
+                out.append(self.process_write1(req))
+            except Exception as exc:  # BadRequest or a processing bug
+                out.append(exc)
+        return out
+
     # ---------------------------------------------------------------- write2
 
     def _cert_stamp(self, wc: WriteCertificate) -> Optional[int]:
@@ -536,6 +560,28 @@ class DataStore:
                 result = self._apply(op, sv, ts, req.write_certificate, transaction)
             results.append(result)
         return Write2AnsFromServer(TransactionResult(tuple(results)), rid="")
+
+    def process_write2_batch(
+        self, reqs: "Iterable[Write2ToServer]"
+    ) -> "List[Write2Response]":
+        """Quorum-check + apply one drained batch of Write2s in a single
+        store entry: one uninterrupted loop turn for the whole batch (the
+        event-loop analog of one lock acquisition — see
+        :meth:`process_write1_batch`), with each transaction judged
+        independently so one bad certificate fails alone.  Callers have
+        already signature-checked every grant (the replica's pooled
+        verifier round trip); this layer enforces quorum shape, hash and
+        timestamp agreement per request, exactly as the single entry point.
+        Unexpected per-request exceptions return as VALUES (same isolation
+        contract as :meth:`process_write1_batch`).
+        """
+        out: List[Write2Response] = []
+        for req in reqs:
+            try:
+                out.append(self.process_write2(req))
+            except Exception as exc:  # a processing bug must fail alone
+                out.append(exc)  # type: ignore[arg-type]
+        return out
 
     def _apply(
         self,

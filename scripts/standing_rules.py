@@ -49,6 +49,56 @@ def lint_gate() -> None:
         raise SystemExit(1)
 
 
+def _host_core_n64_record() -> "tuple[float, str]":
+    """The newest published host-core config6 n64 service record, read from
+    the committed results files at runtime (ADVICE r5: the hardcoded 8.83
+    went stale the moment a newer battery landed — and pinning any single
+    round's file would merely re-create that).  Scans ``results_r*.json``
+    (host batteries; ``*_tpu`` captures are a different posture) newest
+    first; falls back to the r05 constant only when no file carries the
+    record."""
+    import glob
+    import re
+
+    fallback = (8.83, "hardcoded r05 fallback")
+
+    def round_num(path: str) -> int:
+        # numeric round key, NOT lexicographic: "r9" must sort before "r10"
+        m = re.search(r"results_r(\d+)", os.path.basename(path))
+        return int(m.group(1)) if m else -1
+
+    paths = sorted(
+        (
+            p
+            for p in glob.glob(os.path.join(_REPO, "benchmarks", "results_r*.json"))
+            if not p.endswith("_tpu.json")
+        ),
+        key=round_num,
+        reverse=True,
+    )
+    for path in paths:
+        try:
+            with open(path) as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        records = doc if isinstance(doc, list) else [doc]
+        for rec in records:
+            if (
+                isinstance(rec, dict)
+                and rec.get("metric") == "signed_put_north_star_shape_n64_f21"
+                # host-CORE record only: a TPU-service capture of the same
+                # metric (config6 now stamps `platform`) is the OTHER side
+                # of rule 6's comparison, never its baseline.  Records
+                # predating the platform field are host batteries.
+                and rec.get("platform", "cpu") == "cpu"
+            ):
+                rate = (rec.get("n64_f21") or {}).get("txn_per_s")
+                if isinstance(rate, (int, float)):
+                    return (float(rate), os.path.relpath(path, _REPO))
+    return fallback
+
+
 def main() -> None:
     lint_gate()
     round_n = sys.argv[1] if len(sys.argv) > 1 else "05"
@@ -146,10 +196,10 @@ def main() -> None:
     n64 = c6.get("n64_f21") or {}
     if n64:
         tpu_rate = n64.get("txn_per_s", 0)
-        host_rate = 8.83  # published host-core service record (results_r05.json)
+        host_rate, host_src = _host_core_n64_record()
         verdicts.append(
             f"rule 6: config6 TPU-service n64 {tpu_rate} txn/s vs host-core "
-            f"{host_rate} -> "
+            f"{host_rate} ({host_src}) -> "
             + ("record as production posture for BASELINE published.6"
                if tpu_rate >= host_rate else
                "keep host record; note the TPU-service number and its comb_registration field")

