@@ -26,6 +26,12 @@ re-runs skip the 20-60 s per-bucket compiles.
 MFU accounting (VERDICT.md round-1 weak #4): ops/signature from XLA's own
 ``cost_analysis`` on the compiled executable, peak utilization against a
 documented nominal VPU peak.
+
+Comb leg (every platform): the known-signer comb program — the engine the
+replica hot path routes to by default — is measured alongside the ladder
+with its own cost-analysis ops/sig (``ops_per_sig_comb_cost_analysis``),
+an interleaved paired A/B vs the ladder, and the chain-vs-tree COMB_IMPL
+comparison.  See the ``comb`` key of the record.
 """
 
 from __future__ import annotations
@@ -87,6 +93,46 @@ def _tunnel_rtt_ms(dev) -> float:
     return round(times[len(times) // 2] * 1e3, 3)
 
 
+def time_rates(call, batch, depths=(4, 8)):
+    """(sequential rate, {depth: pipelined rate}) with the D2H readback
+    discipline: np.asarray per batch is the only trustworthy sync through
+    the axon relay.  ONE implementation shared by the headline and comb
+    legs here AND by scripts/tpu_flash.py's comb capture — measurement-
+    discipline fixes apply everywhere at once."""
+    import numpy as np
+
+    times = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        np.asarray(call())
+        times.append(time.perf_counter() - t0)
+    seq = batch / min(times)
+    pipe = {}
+    for depth in depths:
+        rates = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            outs = [call() for _ in range(depth)]
+            for o in outs:
+                np.asarray(o)
+            rates.append(depth * batch / (time.perf_counter() - t0))
+        pipe[depth] = round(max(rates), 1)
+    return seq, pipe
+
+
+def cost_analysis_ops_per_item(jitted, n_items, *args, **static_kwargs):
+    """flops/item from XLA's cost analysis of the compiled executable, or
+    None when the backend doesn't expose it — the one extraction shared by
+    the ladder, comb and tree legs (and tpu_flash's comb capture)."""
+    try:
+        cost = jitted.lower(*args, **static_kwargs).compile().cost_analysis()
+        if isinstance(cost, list):
+            cost = cost[0]
+        return float(cost.get("flops", 0.0)) / n_items
+    except Exception:
+        return None
+
+
 def _measure() -> dict:
     import numpy as np
 
@@ -131,13 +177,7 @@ def _measure() -> dict:
         compile_s = time.perf_counter() - t0
         assert np.asarray(out).all()
         if flops_per_sig is None:
-            try:
-                cost = fn.lower(*args).compile().cost_analysis()
-                if isinstance(cost, list):
-                    cost = cost[0]
-                flops_per_sig = float(cost.get("flops", 0.0)) / batch
-            except Exception:
-                flops_per_sig = 0.0
+            flops_per_sig = cost_analysis_ops_per_item(fn, batch, *args) or 0.0
         times = []
         for _ in range(5):
             t0 = time.perf_counter()
@@ -210,28 +250,7 @@ def _measure() -> dict:
     # the rate the BatchingVerifier/service sustains under load, and the
     # honest headline for a throughput metric (scripts/pipeline_bench.py
     # measured 118.6k sigs/s at depth 8 vs 63.6-92k sequential on v5e).
-    def _time_rates(call, batch, depths=(4, 8)):
-        """(sequential rate, {depth: pipelined rate}) with the D2H readback
-        discipline: np.asarray per batch is the only trustworthy sync
-        through the axon relay (one implementation for the headline and
-        comb legs — measurement-discipline fixes apply everywhere)."""
-        times = []
-        for _ in range(5):
-            t0 = time.perf_counter()
-            np.asarray(call())
-            times.append(time.perf_counter() - t0)
-        seq = batch / min(times)
-        pipe = {}
-        for depth in depths:
-            rates = []
-            for _ in range(3):
-                t0 = time.perf_counter()
-                outs = [call() for _ in range(depth)]
-                for o in outs:
-                    np.asarray(o)
-                rates.append(depth * batch / (time.perf_counter() - t0))
-            pipe[depth] = round(max(rates), 1)
-        return seq, pipe
+    _time_rates = time_rates  # module-level shared helper (see its docstring)
 
     pipeline = None
     if best_impl == "xla" and dev.platform == "tpu":
@@ -245,53 +264,127 @@ def _measure() -> dict:
     # ---- known-signer comb path at the best batch -----------------------
     # The cluster's production verify traffic is signed by REGISTERED
     # identities (crypto/comb.py: doubling-free per-signer tables, ~3x
-    # fewer field muls than the ladder).  Measured alongside the headline
-    # so the driver-witnessed record carries both postures; the headline
-    # `value` stays the general-path (arbitrary-key) rate.
+    # fewer field muls than the ladder), and since the comb-first routing
+    # landed it IS the engine that carries the replica hot path — so this
+    # leg runs on EVERY backend (the CPU platform is where the verdict
+    # lives while the TPU tunnel is dead), with:
+    #   * XLA cost-analysis ops/sig for the comb program, published next to
+    #     the ladder's figure (ops_per_sig_xla_cost_analysis) — the op-count
+    #     claim made auditable;
+    #   * an INTERLEAVED same-host paired A/B vs the ladder (alternating
+    #     launches, per-pair ratios, median) so drift cannot masquerade as
+    #     speedup;
+    #   * the chain-vs-tree COMB_IMPL A/B (fewest-ops vs shallowest-chain
+    #     accumulation) at the same batch.
+    # The headline `value` stays the general-path (arbitrary-key) rate.
     comb_rec = None
-    if dev.platform == "tpu":
-        try:
-            from mochi_tpu.crypto import comb as comb_mod
+    comb_flops_per_sig = None
+    try:
+        from mochi_tpu.crypto import comb as comb_mod
 
-            reg = comb_mod.SignerRegistry(device=dev)
-            # no side effects inside asserts: python -O strips them, and a
-            # stripped register() would time an empty zero table
-            registered = reg.register(kp.public_key)
-            if registered is None:
-                raise RuntimeError("signer registration failed")
-            items, _ = prepared(best_batch)  # same workload as the headline
-            (ckey, cy_r, csign_r, cs_sc, ch_sc), cpre_ok = comb_mod._prepare_comb(
-                items, np.zeros(len(items), np.int32), None
-            )
-            if not cpre_ok.all():
-                raise RuntimeError("comb prechecks rejected bench items")
-            table = reg.device_table(dev)
-            cargs = tuple(
-                jax.device_put(a, dev)
-                for a in (ckey, cy_r, csign_r, cs_sc, ch_sc)
-            )
+        reg = comb_mod.SignerRegistry(device=dev)
+        # no side effects inside asserts: python -O strips them, and a
+        # stripped register() would time an empty zero table
+        registered = reg.register(kp.public_key)
+        if registered is None:
+            raise RuntimeError("signer registration failed")
+        items, largs = prepared(best_batch)  # same workload as the headline
+        (ckey, cy_r, csign_r, cs_sc, ch_sc), cpre_ok = comb_mod._prepare_comb(
+            items, np.zeros(len(items), np.int32), None
+        )
+        if not cpre_ok.all():
+            raise RuntimeError("comb prechecks rejected bench items")
+        table = reg.device_table(dev)
+        cargs = tuple(
+            jax.device_put(a, dev)
+            for a in (ckey, cy_r, csign_r, cs_sc, ch_sc)
+        )
+        t0 = time.perf_counter()
+        out = np.asarray(comb_mod._verify_comb_jit(table, *cargs))
+        comb_compile_s = time.perf_counter() - t0
+        if not out.all():
+            raise RuntimeError("comb verdicts wrong on valid signatures")
+        comb_flops_per_sig = cost_analysis_ops_per_item(
+            comb_mod._verify_comb_jit, best_batch, table, *cargs
+        )
+        comb_seq, cpipe = _time_rates(
+            lambda: comb_mod._verify_comb_jit(table, *cargs), best_batch
+        )
+        comb_best = max(comb_seq, max(cpipe.values()))
+        # Interleaved paired A/B against the general ladder: alternating
+        # launches in one process on one host, per-pair time ratios —
+        # the same discipline the config-1 cluster A/B uses, so thermal /
+        # scheduler drift shows up as ratio variance, not as a bogus win.
+        ratios = []
+        for _ in range(7):
             t0 = time.perf_counter()
-            out = np.asarray(comb_mod._verify_comb_jit(table, *cargs))
-            comb_compile_s = time.perf_counter() - t0
-            if not out.all():
-                raise RuntimeError("comb verdicts wrong on valid signatures")
-            comb_seq, cpipe = _time_rates(
-                lambda: comb_mod._verify_comb_jit(table, *cargs), best_batch
+            np.asarray(fn(*largs))
+            t_ladder = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            np.asarray(comb_mod._verify_comb_jit(table, *cargs))
+            t_comb = time.perf_counter() - t0
+            ratios.append(t_ladder / t_comb)
+        ranked = sorted(ratios)
+        paired = {
+            "pairs": len(ratios),
+            # launch order, NOT sorted: a monotone drift (host heating,
+            # background load) must stay visible in the committed record
+            "per_pair_speedup": [round(r, 3) for r in ratios],
+            "median_speedup": round(ranked[len(ranked) // 2], 3),
+            "discipline": "interleaved same-process launches, per-batch "
+            "np.asarray readback, ladder/comb time ratio per pair "
+            "(published in launch order; median over the sorted copy)",
+        }
+        # chain-vs-tree accumulation A/B (static `impl` jit arg — distinct
+        # compiled programs; see crypto/comb.py COMB_IMPL).
+        tree_rec = None
+        try:
+            t0 = time.perf_counter()
+            tout = np.asarray(
+                comb_mod._verify_comb_jit(table, *cargs, impl="tree")
             )
-            comb_best = max(comb_seq, max(cpipe.values()))
-            comb_rec = {
-                "sigs_per_sec_sequential": round(comb_seq, 1),
-                "pipelined_sigs_per_sec_by_depth": cpipe,
-                "best_sigs_per_sec": round(comb_best, 1),
-                "speedup_vs_ladder": round(comb_best / best_rate, 2),
-                "compile_s": round(comb_compile_s, 1),
-                # single signer = best-case gather locality; the K=16/64
-                # cluster-shaped sweep is scripts/comb_bench.py (battery 3f)
-                "registered_signers": 1,
-                "posture": "registered-signer (cluster cert traffic)",
+            tree_compile_s = time.perf_counter() - t0
+            if not tout.all():
+                raise RuntimeError("tree verdicts wrong on valid signatures")
+            tree_flops = cost_analysis_ops_per_item(
+                comb_mod._verify_comb_jit, best_batch, table, *cargs, impl="tree"
+            )
+            tree_times = []
+            for _ in range(5):
+                t0 = time.perf_counter()
+                np.asarray(comb_mod._verify_comb_jit(table, *cargs, impl="tree"))
+                tree_times.append(time.perf_counter() - t0)
+            tree_rec = {
+                "sigs_per_sec_sequential": round(best_batch / min(tree_times), 1),
+                "ops_per_sig_xla_cost_analysis": (
+                    round(tree_flops) if tree_flops else None
+                ),
+                "compile_s": round(tree_compile_s, 1),
             }
-        except Exception as exc:  # never let the extra leg break the headline
-            comb_rec = {"error": f"{type(exc).__name__}: {exc}"[:300]}
+        except Exception as exc:
+            tree_rec = {"error": f"{type(exc).__name__}: {exc}"[:300]}
+        comb_rec = {
+            "sigs_per_sec_sequential": round(comb_seq, 1),
+            "pipelined_sigs_per_sec_by_depth": cpipe,
+            "best_sigs_per_sec": round(comb_best, 1),
+            "speedup_vs_ladder": round(comb_best / best_rate, 2),
+            "paired_ab_vs_ladder": paired,
+            "impl": comb_mod.COMB_IMPL,
+            "ops_per_sig_xla_cost_analysis": (
+                round(comb_flops_per_sig) if comb_flops_per_sig else None
+            ),
+            "ops_per_sig_ladder": round(flops_per_sig or 0.0),
+            "tree_impl": tree_rec,
+            "compile_s": round(comb_compile_s, 1),
+            # single signer = best-case gather locality; the K=16/64
+            # cluster-shaped sweep is scripts/comb_bench.py (battery 3f)
+            "registered_signers": 1,
+            "posture": "registered-signer (cluster cert traffic; the "
+            "replica hot path routes here by default since the comb-first "
+            "engine landed)",
+        }
+    except Exception as exc:  # never let the extra leg break the headline
+        comb_rec = {"error": f"{type(exc).__name__}: {exc}"[:300]}
 
     # ---- CPU baselines --------------------------------------------------
     items, _ = prepared(1024)
@@ -361,6 +454,11 @@ def _measure() -> dict:
         "vs_cpu_allcores": round(best_rate / cpu_allcores, 3) if cpu_allcores else None,
         "cpu_cores": ncores,
         "ops_per_sig_xla_cost_analysis": round(flops_per_sig or 0.0),
+        # the comb program's op count published NEXT TO the ladder's: the
+        # known-signer engine the replica hot path routes to by default
+        "ops_per_sig_comb_cost_analysis": (
+            round(comb_flops_per_sig) if comb_flops_per_sig else None
+        ),
         "mfu_vs_vpu_peak": round(mfu, 4) if mfu is not None else None,
         "vpu_peak_int_ops": vpu_peak,
         "vpu_peak_source": vpu_peak_source,

@@ -42,6 +42,78 @@ from typing import Dict, List
 from benchmarks.config1_cluster import _pct  # one percentile rule for all configs
 
 
+def _pooled_ms(metrics_list, name: str) -> Dict:
+    """Pool one named timer's sample windows across many registries
+    (worker clients, or all n replicas) into p50/p95/mean ms + count.
+
+    Pooling the raw reservoirs (not averaging per-registry percentiles)
+    keeps the statistic honest when registries carry very different sample
+    counts — e.g. 8 worker clients with 5 commits each."""
+    samples: List[float] = []
+    count = 0
+    total = 0.0
+    for m in metrics_list:
+        t = m.timers.get(name)
+        if t is None:
+            continue
+        samples.extend(t.samples)
+        count += t.total_count
+        total += t.total_seconds
+    if not samples:
+        return {"count": 0}
+    return {
+        "count": count,
+        "mean_ms": round(total / count * 1e3, 2),
+        "p50_ms": round(_pct(samples, 0.50) * 1e3, 2),
+        "p95_ms": round(_pct(samples, 0.95) * 1e3, 2),
+    }
+
+
+def _commit_breakdown(clients, replicas, txns: int) -> Dict:
+    """Decompose the signed-PUT commit path from the stage timers the
+    client and replicas already recorded during the workload (VERDICT r5
+    weak #5: the 755 ms n=64 p50 had no decomposition).
+
+    Stages (client clock): ``write1_phase`` = grant fan-out round trip;
+    ``write2_fanout_wait`` = certificate fan-out through last response
+    (CONTAINS each replica's verify wait + store apply + wire/loop time);
+    ``write2_tally`` = client-side quorum tally after the last response.
+    ``envelope_encode_sign`` is per TARGET (n per fan-out), so its mean ×
+    2n approximates the client's total serialization share per txn.
+    Replica clock: ``verify_wait`` = the SPI round trip a batch's auth +
+    cert checks share; ``store_apply_write2``/``grant_issue_write1`` = the
+    batched store entries; ``replica_crypto_local`` = synchronous host
+    crypto (MACs, grant signs)."""
+    client_ms = {
+        "write1_phase": _pooled_ms(clients, "write1-phase"),
+        "write2_fanout_wait": _pooled_ms(clients, "write2-fanout-wait"),
+        "write2_tally": _pooled_ms(clients, "write2-tally"),
+        "envelope_encode_sign": _pooled_ms(clients, "envelope-encode-sign"),
+        # synchronous build+serialize+send loop per fan-out (one sample per
+        # fan-out, covering all n targets) — net/transport.py fan_out
+        "fanout_serialize_send": _pooled_ms(clients, "fanout-serialize-send"),
+    }
+    replica_ms = {
+        "verify_wait": _pooled_ms(replicas, "replica.auth-verify"),
+        "store_apply_write2": _pooled_ms(replicas, "replica.write2"),
+        "grant_issue_write1": _pooled_ms(replicas, "replica.write1"),
+        "replica_crypto_local": _pooled_ms(replicas, "replica.crypto-local"),
+    }
+    out: Dict = {"client_ms": client_ms, "replica_ms": replica_ms, "txns": txns}
+    w1 = client_ms["write1_phase"].get("p50_ms")
+    w2 = client_ms["write2_fanout_wait"].get("p50_ms")
+    tally = client_ms["write2_tally"].get("p50_ms")
+    if None not in (w1, w2, tally):
+        stages = {
+            "write1_phase": w1,
+            "write2_fanout_wait": w2,
+            "write2_tally": tally,
+        }
+        out["dominant_stage"] = max(stages, key=stages.get)  # type: ignore[arg-type]
+        out["stage_p50_sum_ms"] = round(sum(stages.values()), 1)
+    return out
+
+
 async def _run_shape(
     n: int, writers: int, writes_per_writer: int, verifier: str
 ) -> Dict:
@@ -117,6 +189,14 @@ async def _run_shape(
             t0 = time.perf_counter()
             await asyncio.gather(*[worker(i) for i in range(writers)])
             wall = time.perf_counter() - t0
+            # Stage decomposition from the timers the workload just filled
+            # (clients captured before the cert read-back client joins).
+            workload_clients = list(vc._clients)
+            breakdown = _commit_breakdown(
+                [c.metrics for c in workload_clients],
+                [r.metrics for r in vc.replicas],
+                len(write_lat),
+            )
 
             # Certificate shape evidence from a read-back: grants kept
             # after quorum-cover trimming + wire size of the signed cert.
@@ -151,6 +231,7 @@ async def _run_shape(
             # every replica in the set verifies every grant of every cert
             "grant_verifies_per_s_cluster": round(txn_s * n * quorum, 1),
             "writers": writers,
+            "commit_breakdown_ms": breakdown,
         }
         if cert_bytes:
             rec["cert_wire_bytes"] = cert_bytes[0]
@@ -177,15 +258,23 @@ def run(
     (authoritative numbers live in benchmarks/results_r05.json, not here),
     and the effect is the whole thesis of the shared TPU-verifier design
     at this scale."""
-    from mochi_tpu.utils.runtime import tune_gc_for_server
+    from mochi_tpu.utils.runtime import reset_gc_debt, tune_gc_for_server
 
     tune_gc_for_server()
-    # n=16 FIRST: the n=64 run leaves enough long-lived garbage under the
-    # relaxed server GC thresholds to depress a following small-shape run
-    # ~45% (measured 40 vs 72-75 txn/s standalone); small-before-big keeps
-    # both records clean of each other.
+    # Shapes are GC-isolated by reset_gc_debt(), not by ordering: the r5
+    # "n64-first depresses a following n16 ~45%" artifact was GC DEBT — the
+    # torn-down 64-replica object graph is cyclic (replicas↔stores↔grant
+    # books, task callbacks), so under the relaxed server thresholds
+    # (50000/50/50) it sits uncollected while the next shape's allocations
+    # repeatedly trigger young-gen collections that trace the dead giant
+    # graph; collect-and-refreeze between shapes returns the small shape to
+    # within noise of its standalone rate in either order (root cause +
+    # measurements: BASELINE.md; regression: tests/test_bigcluster.py
+    # run-order-independence test).
     mid = asyncio.run(_run_shape(16, writers, writes_per_writer, verifier))
+    reset_gc_debt()
     big = asyncio.run(_run_shape(64, writers, writes_per_writer, verifier))
+    reset_gc_debt()
     # Detected backend platform, so records merged from OUTSIDE run_all's
     # battery loop (which stamps it post-hoc) carry the same schema as
     # every other config (ADVICE r5).

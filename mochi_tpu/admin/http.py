@@ -66,6 +66,19 @@ def _esc(s) -> str:
     )
 
 
+def _walk_numeric(prefix: str, obj: dict, out: list) -> None:
+    """Flatten a stats dict's numeric leaves into (dotted_name, value) —
+    bools as 0/1, lists skipped (bucket lists are not scalar gauges)."""
+    for k, v in obj.items():
+        key = f"{prefix}.{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            _walk_numeric(key, v, out)
+        elif isinstance(v, bool):
+            out.append((key, int(v)))
+        elif isinstance(v, (int, float)):
+            out.append((key, v))
+
+
 def _rows(d: dict) -> str:
     return "".join(
         f"<tr><td>{_esc(k)}</td><td>{_esc(v)}</td></tr>" for k, v in d.items()
@@ -199,11 +212,21 @@ class AdminServer(HttpJsonServer):
             # Prometheus text exposition for a standard scrape stack (the
             # reference exposed Dropwizard timers via a JMX reporter,
             # MochiDBClient.java:52-70; this is the modern equivalent).
-            return (
-                200,
-                "text/plain; version=0.0.4",
-                r.metrics.to_prometheus({"server": r.server_id}),
-            )
+            body = r.metrics.to_prometheus({"server": r.server_id})
+            # Verifier-composition gauges (numeric leaves of verifier_stats,
+            # flattened) — includes the comb routing/dispatch counters, so
+            # "is the known-signer fast path carrying this replica's cert
+            # traffic?" is answerable from a scrape (docs/OPERATIONS.md
+            # §"Comb-first verification").
+            samples: list = []
+            _walk_numeric("", verifier_stats(r.verifier), samples)
+            if samples:
+                sid = str(r.server_id).replace("\\", "\\\\").replace('"', '\\"')
+                body += "# TYPE mochi_verifier gauge\n" + "".join(
+                    f'mochi_verifier{{name="{k}",server="{sid}"}} {v}\n'
+                    for k, v in samples
+                )
+            return (200, "text/plain; version=0.0.4", body)
         if path == "/" or path == "/index.html":
             cfg = r.config
             member_rows = "".join(

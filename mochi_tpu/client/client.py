@@ -186,16 +186,21 @@ class MochiDBClient:
         return txn is not None and cls._is_admin_txn(txn)
 
     def _envelope(self, payload, msg_id: str, sid: Optional[str] = None) -> Envelope:
-        env = Envelope(
-            payload=payload,
-            msg_id=msg_id,
-            sender_id=self.client_id,
-            timestamp_ms=int(time.time() * 1000),
-        )
-        session_key = self._sessions.get(sid) if sid is not None else None
-        if session_key is not None and not self._needs_signature(payload):
-            return session_crypto.seal(env, session_key)
-        return env.with_signature(self.keypair.sign(env.signing_bytes()))
+        # Timed per target: this is the client's per-envelope serialization
+        # cost (payload encode — cached after the first target — plus the
+        # MAC/sign), the "fan-out serialization" slice of the commit
+        # breakdown (benchmarks/config6_bigcluster.py).
+        with self.metrics.timer("envelope-encode-sign"):
+            env = Envelope(
+                payload=payload,
+                msg_id=msg_id,
+                sender_id=self.client_id,
+                timestamp_ms=int(time.time() * 1000),
+            )
+            session_key = self._sessions.get(sid) if sid is not None else None
+            if session_key is not None and not self._needs_signature(payload):
+                return session_crypto.seal(env, session_key)
+            return env.with_signature(self.keypair.sign(env.signing_bytes()))
 
     def _authentic(self, sid: str, env: Envelope) -> bool:
         if not self.authenticate_servers:
@@ -331,6 +336,7 @@ class MochiDBClient:
             targets,
             lambda msg_id, sid: self._envelope(payload_factory(), msg_id, sid),
             self.timeout_s,
+            metrics=self.metrics,
         )
         out: Dict[str, object] = {}
         stale_sessions = []
@@ -681,15 +687,16 @@ class MochiDBClient:
                 w1_payload = Write1ToServer(
                     self.client_id, write1_txn, seed, txn_hash
                 )
-                responses = await self._fan_out(
-                    write1_txn,
-                    lambda: w1_payload,
-                    targets=(
-                        self._quorum_targets(write1_txn)
-                        if attempt == 0 and self.trim_write1
-                        else None
-                    ),
-                )
+                with self.metrics.timer("write1-phase"):
+                    responses = await self._fan_out(
+                        write1_txn,
+                        lambda: w1_payload,
+                        targets=(
+                            self._quorum_targets(write1_txn)
+                            if attempt == 0 and self.trim_write1
+                            else None
+                        ),
+                    )
                 oks: List[MultiGrant] = []
                 for sid, p in responses.items():
                     if isinstance(p, Write1OkFromServer) and p.multi_grant.server_id == sid:
@@ -817,7 +824,18 @@ class MochiDBClient:
         # was re-encoded per target (96% of envelope encode cost, round-5
         # profile); the payload-level mcode cache makes this one encode.
         w2_payload = Write2ToServer(certificate, transaction)
-        responses = await self._fan_out(transaction, lambda: w2_payload)
+        # Stage-timed for the commit breakdown (config-6): the fan-out wait
+        # spans send-to-all through last-response/timeout — it CONTAINS each
+        # replica's verify wait + store apply plus the wire/loop time; the
+        # tally is pure client CPU after the last response lands.
+        with self.metrics.timer("write2-fanout-wait"):
+            responses = await self._fan_out(transaction, lambda: w2_payload)
+        with self.metrics.timer("write2-tally"):
+            return self._tally_write2(transaction, responses)
+
+    def _tally_write2(
+        self, transaction: Transaction, responses: Dict[str, object]
+    ) -> TransactionResult:
         n_ops = len(transaction.operations)
         final: List = []
         for i in range(n_ops):

@@ -315,6 +315,7 @@ def verify_batch(
             else:
                 comb_pos.append(i)
                 kidx.append(k)
+        _note_routing(len(comb_pos), len(gen_pos))
         if comb_pos:
             comb_items = [items[i] for i in comb_pos]
             key_arr = np.asarray(kidx, dtype=np.int32)
@@ -491,6 +492,36 @@ def _note_dispatch(comb: bool = False) -> None:
 def thread_dispatch_counts() -> tuple:
     """(general, comb) dispatches made by THIS thread (monotone)."""
     return (getattr(_tls, "general", 0), getattr(_tls, "comb", 0))
+
+
+# Router occupancy (process-global, monotone): how many items the known-
+# signer router sent down each leg, and how often one verify_batch call
+# carried BOTH programs in a single merged-bitmap round trip.  Only counted
+# at the routing decision (registry present), so the general-only fast path
+# costs nothing; surfaced by verifier_stats → admin /status and
+# /metrics.prom (docs/OPERATIONS.md §"Comb-first verification").
+_comb_items_routed = 0
+_ladder_items_routed = 0
+_mixed_batches = 0
+
+
+def _note_routing(n_comb: int, n_ladder: int) -> None:
+    global _comb_items_routed, _ladder_items_routed, _mixed_batches
+    with _dispatch_count_lock:
+        _comb_items_routed += n_comb
+        _ladder_items_routed += n_ladder
+        if n_comb and n_ladder:
+            _mixed_batches += 1
+
+
+def comb_routing_counts() -> dict:
+    """Snapshot of the router's occupancy counters (monotone totals)."""
+    with _dispatch_count_lock:
+        return {
+            "comb_items": _comb_items_routed,
+            "ladder_items": _ladder_items_routed,
+            "mixed_batches": _mixed_batches,
+        }
 
 
 def device_dispatch_count() -> int:

@@ -116,6 +116,23 @@ _TABLE_PROMOTE_AFTER = 2
 # holds tables.
 _TABLE_CACHE_SIZE = 128
 _seen_signers: dict = {}  # compressed key -> VERIFIED count (bounded)
+# Keys promoted by explicit registration (cluster identities via
+# prime_signers).  They survive the reset-when-full below: organic signers
+# re-earn promotion after a reset, but a boot-time-registered replica
+# identity must never silently fall back to the ladder because 128 client
+# keys verified in between.  Insertion-ordered and bounded by the table
+# cache size: when full, the OLDEST primed key is evicted — a long-lived
+# process that re-registers across reconfigurations (or a test session
+# booting many clusters) keeps hints for the CURRENT membership, not the
+# first 128 identities it ever saw.  Values are unused (dict-as-ordered-set).
+_primed_signers: dict = {}
+
+
+def _reset_signer_tracker() -> None:
+    """Reset-when-full, preserving primed (registered) identities."""
+    _seen_signers.clear()
+    for pk in _primed_signers:
+        _seen_signers[pk] = _TABLE_PROMOTE_AFTER
 
 
 def _window_table(point: _Pt) -> Tuple[Tuple[_Pt, ...], ...]:
@@ -143,6 +160,49 @@ def _signer_table(compressed: bytes) -> Optional[Tuple[Tuple[_Pt, ...], ...]]:
     if point is None:
         return None
     return _window_table(point)
+
+
+def prime_signers(pubs) -> bool:
+    """Pre-promote known signers (cluster identities) so their FIRST verify
+    runs on the windowed comb table instead of earning promotion with two
+    ~380-addition ladder verifies.  Called via
+    :func:`mochi_tpu.crypto.keys.register_known_signers` from the verifier
+    SPI's ``register_signers`` at replica boot and on reconfiguration.
+
+    O(1) per key: only the promotion counter is touched — the ~960-addition
+    table build stays lazy (first verify), and keys that are not canonical
+    curve points are skipped (they can never verify, so a table would be
+    wasted).  Primed keys are REMEMBERED across the organic tracker's
+    reset-when-full (``_reset_signer_tracker``): a flood of distinct
+    client signers must not demote boot-registered cluster identities back
+    to the ladder.  Bounded at the table-cache size with oldest-first
+    eviction (re-registration refreshes recency), so the promoted set can
+    never outgrow the LRU (which would invert the comb into table-rebuild
+    thrash — see ``_TABLE_CACHE_SIZE``) and the hints always describe the
+    most recently registered memberships.
+    """
+    primed = False
+    for pk in pubs:
+        pk = bytes(pk)
+        if pk in _primed_signers:
+            # refresh recency (dict preserves insertion order = LRU order)
+            _primed_signers[pk] = _primed_signers.pop(pk)
+            primed = True
+            continue
+        if len(pk) != 32 or _decompress(pk) is None:
+            continue
+        # Cap at HALF the table cache (= the n=64 design-size membership):
+        # a primed set that filled the whole tracker would re-fill it on
+        # every reset and starve organic client signers of promotion
+        # forever, while primed + organic together overflowed the table
+        # LRU into rebuild thrash.  Oldest-first eviction keeps the hints
+        # on the most recently registered memberships.
+        while len(_primed_signers) >= _TABLE_CACHE_SIZE // 2:
+            _primed_signers.pop(next(iter(_primed_signers)))  # evict oldest
+        _primed_signers[pk] = True
+        _seen_signers[pk] = max(_seen_signers.get(pk, 0), _TABLE_PROMOTE_AFTER)
+        primed = True
+    return primed
 
 
 def _mul_signer(k: int, table: Tuple[Tuple[_Pt, ...], ...]) -> _Pt:
@@ -266,7 +326,9 @@ def _verify_cached(public_key: bytes, signature: bytes, h_digest: bytes) -> bool
     ok = _pt_eq(_mul_base(s), _pt_add(r_point, ha))
     if ok:
         if len(_seen_signers) >= _TABLE_CACHE_SIZE and public_key not in _seen_signers:
-            _seen_signers.clear()  # promoted set must fit the table cache
+            # promoted set must fit the table cache; primed (registered)
+            # identities are re-seeded by the reset, organic keys re-earn
+            _reset_signer_tracker()
         _seen_signers[public_key] = _seen_signers.get(public_key, 0) + 1
     return ok
 

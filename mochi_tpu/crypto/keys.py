@@ -121,6 +121,23 @@ def _canonical(public_key: bytes, signature: bytes) -> bool:
     return y_a < _P and y_r < _P and s < _L
 
 
+def register_known_signers(pubs) -> bool:
+    """Pre-promote known signers (cluster replica identities) in the host
+    verify engine; returns whether the hint reached an engine that uses it.
+
+    With OpenSSL present this is a no-op (its verify has no per-signer
+    state worth warming).  On wheel-less hosts the pure-Python engine keeps
+    per-signer fixed-window tables (:mod:`~mochi_tpu.crypto.hostfallback`,
+    the host analog of the device comb) that normally require two verified
+    signatures to earn; pre-promotion makes the FIRST certificate check
+    from a cluster identity run combed.  O(1) per key — table builds stay
+    lazy (first verify), so boot cost is nil.
+    """
+    if _HAVE_HOST_CRYPTO:
+        return False
+    return _fallback().prime_signers(pubs)
+
+
 def verify(public_key: bytes, message: bytes, signature: bytes) -> bool:
     """Single-signature CPU verify; returns False on any malformed input.
 

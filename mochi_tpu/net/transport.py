@@ -761,13 +761,18 @@ async def fan_out(
     targets: Iterable[Tuple[str, ServerInfo]],
     make_envelope: Callable[..., Envelope],
     timeout_s: Optional[float] = None,
+    metrics=None,
 ) -> Dict[str, "Envelope | Exception"]:
     """Send one envelope per target concurrently; gather results or exceptions
     per server id (ref: ``Utils.sendMessageToServers`` + ``busyWaitForFutures``,
     ``Utils.java:65-123`` — awaiting real futures instead of 5 ms poll loops).
 
     ``make_envelope`` is called as ``(msg_id, server_id)`` so callers can
-    authenticate per target (session MACs).
+    authenticate per target (session MACs).  ``metrics`` (a
+    :class:`~mochi_tpu.utils.metrics.Metrics`) times the synchronous
+    build+serialize+send loop as ``fanout-serialize-send`` — the "fan-out
+    serialization" slice of the commit breakdown, distinct from the
+    response wait that follows.
     """
     targets = list(targets)
     # `is None` (not falsy-or): an explicit timeout_s=0 means "no waiting",
@@ -783,6 +788,7 @@ async def fan_out(
     loop = asyncio.get_running_loop()
     waiting: List[Tuple[str, asyncio.Future, str, _Connection]] = []
     slow: List[Tuple[str, ServerInfo]] = []
+    send_t0 = time.perf_counter() if metrics is not None else 0.0
     for sid, info in targets:
         conn = pool._conn(info)
         if not conn.connected:
@@ -799,6 +805,10 @@ async def fan_out(
             out[sid] = exc
             continue
         waiting.append((sid, fut, env.msg_id, conn))
+    if metrics is not None:
+        metrics.timers["fanout-serialize-send"].record(
+            time.perf_counter() - send_t0
+        )
 
     async def one(sid: str, info: ServerInfo) -> Envelope:
         # wait_for bounds the WHOLE leg including the TCP connect inside

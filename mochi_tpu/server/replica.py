@@ -151,6 +151,13 @@ class MochiReplica:
     # ----------------------------------------------------------------- boot
 
     async def start(self) -> None:
+        # Comb-first default: the cluster's replica identities are known
+        # signers, so every verifier composition gets them at boot (the SPI
+        # routes the registration to whatever layer can use it — the device
+        # comb registry, the host fallback's window tables — and silently
+        # no-ops elsewhere).  Best-effort by design: a failed registration
+        # leaves that traffic on the general ladder, never unverified.
+        self._register_config_signers(self.config)
         if self.snapshot_path:
             from . import persistence
 
@@ -320,21 +327,14 @@ class MochiReplica:
             new_cfg.configstamp, old.configstamp, added, removed,
         )
         self.metrics.mark("replica.config-installs")
-        # New member identities join the verifier's known-signer registry
-        # (comb fast path, crypto/comb.py).  Without this their grant
-        # certificates still verify — just on the general ladder — so the
-        # call is best-effort by design.
-        if added and hasattr(self.verifier, "register_signers"):
-            try:
-                self.verifier.register_signers(
-                    [
-                        new_cfg.public_keys[sid]
-                        for sid in added
-                        if sid in new_cfg.public_keys
-                    ]
-                )
-            except Exception:
-                LOG.exception("signer registration after reconfig failed")
+        # Re-register the FULL membership's identities with the verifier's
+        # known-signer machinery (comb fast path, crypto/comb.py) —
+        # registration is idempotent, and the full set also repairs any
+        # identity a pre-boot snapshot install raced past.  Without this
+        # the new members' grant certificates still verify — just on the
+        # general ladder — so the call is best-effort by design.
+        if added or removed:
+            self._register_config_signers(new_cfg)
         if self.server_id not in new_cfg.servers:
             LOG.warning(
                 "this server is not a member of config cs=%d — retired "
@@ -346,6 +346,18 @@ class MochiReplica:
             # keys from peers in the background.
             self._pending_sync_keys.add("*")
             self._kick_sync_worker()
+
+    def _register_config_signers(self, cfg: ClusterConfig) -> None:
+        """Hand the membership's public keys to the verifier's known-signer
+        registration (SPI ``register_signers``); best-effort, idempotent."""
+        reg = getattr(self.verifier, "register_signers", None)
+        if not callable(reg):
+            return
+        try:
+            if reg(list(cfg.public_keys.values())):
+                self.metrics.mark("replica.signers-registered", len(cfg.public_keys))
+        except Exception:
+            LOG.exception("known-signer registration failed")
 
     # ------------------------------------------------------------- envelopes
 

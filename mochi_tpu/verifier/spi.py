@@ -46,6 +46,44 @@ class SignatureVerifier:
     async def close(self) -> None:
         pass
 
+    def register_signers(self, pubs: Sequence[bytes]) -> bool:
+        """Route known-signer registration (cluster replica identities) to
+        every layer of this composition that can exploit it, and report
+        whether any did.
+
+        This is how the comb fast path becomes the DEFAULT engine rather
+        than an opt-in: the replica calls this once at boot and on every
+        reconfiguration with the cluster config's public keys, whatever
+        verifier composition it was built with.  The default walks the
+        standard composition attributes — ``inner`` (Caching/Coalescing
+        wrappers), ``backend`` (BatchingVerifier → JaxBatchBackend, which
+        owns the device :class:`~mochi_tpu.crypto.comb.SignerRegistry`) and
+        ``fallback`` (the CPU path, whose pure-Python engine keeps per-
+        signer window tables) — so registration reaches the device registry
+        AND the host fallback through any stack.  Registration is always
+        best-effort: an unreachable layer leaves that traffic on the
+        general ladder, never unverified.
+        """
+        routed = False
+        for attr in ("inner", "backend", "fallback"):
+            target = getattr(self, attr, None)
+            if target is None or target is self:
+                continue
+            reg = getattr(target, "register_signers", None)
+            if callable(reg):
+                try:
+                    # None (e.g. JaxBatchBackend) means "registered"; only
+                    # an explicit False ("nothing here uses signer hints",
+                    # e.g. the OpenSSL CPU path) leaves `routed` unset.
+                    routed = (reg(list(pubs)) is not False) or routed
+                except Exception:
+                    LOG.exception(
+                        "signer registration via %s.%s failed; its traffic "
+                        "stays on the general verify path",
+                        type(self).__name__, attr,
+                    )
+        return routed
+
 
 class CpuVerifier(SignatureVerifier):
     """Inline host verification (the reference-analog CPU path)."""
@@ -58,6 +96,14 @@ class CpuVerifier(SignatureVerifier):
             crypto_keys.verify(it.public_key, it.message, it.signature)  # mochi-lint: disable=async-blocking
             for it in items
         ]
+
+    def register_signers(self, pubs: Sequence[bytes]) -> bool:
+        # With OpenSSL installed this is a no-op (per-verify cost is already
+        # ~120 us); on wheel-less hosts it pre-promotes the pure-Python
+        # engine's per-signer window tables (the host analog of the device
+        # comb) so the FIRST certificate check runs combed instead of
+        # paying two ~380-addition ladders to earn promotion.
+        return crypto_keys.register_known_signers(pubs)
 
 
 class CoalescingVerifier(SignatureVerifier):
@@ -425,8 +471,10 @@ def verifier_stats(verifier) -> dict:
         # comb fast-path observability (crypto/comb.py): is the registry
         # populated, which buckets have a compiled comb program, and is
         # the path actually carrying traffic
+        from ..crypto import batch_verify as _bv
         from ..crypto.comb import comb_dispatch_count
 
+        routed = _bv.comb_routing_counts()
         st["comb"] = {
             "registered_signers": len(registry),
             "ready_buckets": (
@@ -437,6 +485,12 @@ def verifier_stats(verifier) -> dict:
                 else sorted(list(getattr(backend, "_ready_comb", {})))
             ),
             "device_dispatches_process_total": comb_dispatch_count(),
+            # mixed-batch routing occupancy (process-global): how many items
+            # the router sent down each leg, and how often a single SPI
+            # round trip carried both programs (the merged-bitmap case)
+            "items_comb_routed_process_total": routed["comb_items"],
+            "items_ladder_routed_process_total": routed["ladder_items"],
+            "mixed_batches_process_total": routed["mixed_batches"],
         }
     inner = getattr(verifier, "inner", None)
     if inner is not None:

@@ -24,14 +24,21 @@ from .spi import BatchingVerifier, SignatureVerifier
 
 
 class _SignerRegistrationMixin:
-    """Shared one-liner delegating signer registration to the backend (both
-    verifier classes store ``_warmup_buckets``; keeping ONE definition
-    avoids silent divergence).  See
+    """Shared registration hook for the device-backed verifiers (both store
+    ``_warmup_buckets``; keeping ONE definition avoids silent divergence).
+    Registers with the backend FIRST — passing the warmup buckets so comb
+    programs re-warm for the grown registry (see
     :meth:`mochi_tpu.crypto.batch_verify.JaxBatchBackend.register_signers`
-    for the no-stall growth semantics."""
+    for the no-stall growth semantics) — then runs the base SPI walk so the
+    registration ALSO reaches the CPU ``fallback`` (host comb priming on
+    wheel-less hosts): if the device path ever degrades to the fallback,
+    cluster signers are already promoted there.  The walk's second visit to
+    ``backend`` is an idempotent no-op (no growth → no recompiles)."""
 
-    def register_signers(self, pubs: Sequence[bytes]) -> None:
+    def register_signers(self, pubs: Sequence[bytes]) -> bool:
         self.backend.register_signers(pubs, extra_buckets=self._warmup_buckets)
+        SignatureVerifier.register_signers(self, pubs)
+        return True
 
 
 class TpuBatchVerifier(_SignerRegistrationMixin, BatchingVerifier):
@@ -178,6 +185,12 @@ class ShardedJaxBatchBackend(JaxBatchBackend):
                 use_comb = False
             else:
                 key_idx = np.asarray(idxs, dtype=np.int32)
+            # router occupancy: all-or-nothing per launch here, so a batch
+            # with any unregistered key counts whole as ladder traffic
+            batch_verify._note_routing(
+                len(items) if use_comb else 0,
+                0 if use_comb else len(items),
+            )
         y_a, sign_a, y_r, sign_r, s_sc, h_sc, pre_ok = batch_verify.prepare_packed(items)
         if not pre_ok.any():
             # All-rejected chunk (garbage flood): no device work, and —

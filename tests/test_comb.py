@@ -103,6 +103,7 @@ def _mixed_items(signers, n=64):
     return items
 
 
+@pytest.mark.slow
 def test_comb_matches_openssl_and_ladder(signers, registry):
     items = _mixed_items(signers)
     expect = _expected(items)
@@ -113,6 +114,7 @@ def test_comb_matches_openssl_and_ladder(signers, registry):
     assert any(expect) and not all(expect)  # the mix is non-trivial
 
 
+@pytest.mark.slow
 def test_mixed_registered_and_unregistered(signers, registry):
     stranger = keys.generate_keypair()  # never registered
     items = []
@@ -161,6 +163,7 @@ def test_noncanonical_r_rejected(signers, registry):
     assert batch_verify.verify_batch(items) == [False]
 
 
+@pytest.mark.slow
 def test_registry_growth_across_capacity_boundary():
     # capacity pads to powers of two (min 8): crossing 8 -> 16 must
     # invalidate the cached device table and keep verdicts correct
@@ -255,6 +258,7 @@ def test_comb_only_service_chunks_at_comb_buckets(signers):
     assert backend._comb_pinned_gen(32) is None  # not synchronously compiled
 
 
+@pytest.mark.slow
 def test_sharded_comb_matches_openssl_on_cpu_mesh(signers):
     """Sharded comb (shard_map over the 8-device CPU mesh, table
     replicated) produces the same bitmap as OpenSSL — the config-5 /
@@ -353,6 +357,7 @@ def test_cluster_protocol_over_comb_verifier():
     assert any(b._ready_comb for b in backends)
 
 
+@pytest.mark.slow
 def test_tree_impl_matches_chain_and_openssl(signers, registry):
     """The tree accumulation (MOCHI_COMB_IMPL=tree: one-hot MXU select +
     balanced reduction) must produce bit-identical verdicts to the chain
@@ -395,6 +400,7 @@ def test_comb_chunked_pipeline_path(monkeypatch, signers, registry):
     assert batch_verify.verify_batch(items, registry=registry) == expect
 
 
+@pytest.mark.slow
 def test_comb_randomized_mutation_fuzz(signers, registry):
     """Batched randomized differential fuzz: random byte flips at random
     positions in signature/pubkey/message, random message lengths, random
@@ -459,6 +465,7 @@ def test_comb_table_math_against_host_ints(signers):
     )
 
 
+@pytest.mark.slow
 def test_device_matmuls_pin_highest_precision():
     """Every dot_general in the comb programs must carry explicit
     Precision.HIGHEST: TPU's DEFAULT f32 matmul decomposes through bf16

@@ -64,6 +64,7 @@ class TestField:
             F.SKEW_IMPL = prev
         assert (r1 == r2).all()
 
+    @pytest.mark.slow
     def test_pow_invert_canonical(self):
         xs, _, A, _ = self._rand_pairs(n=4, seed=2)
         p = F.P_INT

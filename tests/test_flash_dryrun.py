@@ -10,6 +10,8 @@ claim the round headline slot).
 
 import importlib.util
 import json
+
+import pytest
 import os
 import sys
 
@@ -25,6 +27,7 @@ def _load_flash():
     return mod
 
 
+@pytest.mark.slow
 def test_flash_capture_dryrun(tmp_path, monkeypatch):
     flash = _load_flash()
     monkeypatch.setattr(flash, "_REPO", str(tmp_path))

@@ -39,6 +39,7 @@ def mesh():
     return make_mesh(8)
 
 
+@pytest.mark.slow
 def test_sharded_verify_matches_expected(mesh):
     items = _signed_items(16, forge={3, 10})
     y_a, sign_a, y_r, sign_r, s_bits, h_bits, pre_ok = prepare(items)
@@ -50,6 +51,7 @@ def test_sharded_verify_matches_expected(mesh):
     assert (bitmap == expect).all()
 
 
+@pytest.mark.slow
 def test_quorum_step_tally_and_commit(mesh):
     # 4 quorum slots x 4 votes each; forge one vote in slot 1 and three in
     # slot 2 -> with threshold 3 slots {0,1,3} commit, slot 2 does not.
@@ -67,6 +69,7 @@ def test_quorum_step_tally_and_commit(mesh):
     assert bitmap.sum() == 12
 
 
+@pytest.mark.slow
 def test_pad_to_multiple_dead_groups(mesh):
     n, n_groups = 10, 3
     items = _signed_items(n)
@@ -84,6 +87,7 @@ def test_pad_to_multiple_dead_groups(mesh):
     assert counts[n_groups] == 0
 
 
+@pytest.mark.slow
 def test_sharded_backend_all_rejected_skips_device(mesh):
     """ShardedJaxBatchBackend: a garbage-flood chunk (every precheck fails)
     returns all-False without dispatching the mesh program, and without

@@ -41,6 +41,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+@pytest.mark.slow
 def test_two_process_mesh_quorum_step():
     port = _free_port()
     lanes = 8  # per process; lanes i%4==3 corrupted, group = i%3

@@ -9,6 +9,8 @@ shared service.
 
 import asyncio
 
+import pytest
+
 from mochi_tpu.client import TransactionBuilder
 from mochi_tpu.crypto.keys import generate_keypair
 from mochi_tpu.testing import VirtualCluster
@@ -236,6 +238,7 @@ def test_service_status_counters_and_admin_endpoint():
     run(main())
 
 
+@pytest.mark.slow
 def test_sharded_backend_over_cpu_mesh():
     """ShardedTpuBatchVerifier splits a mixed batch over the 8-device CPU
     mesh (conftest forces it) and returns the same bitmap the CPU verifier
