@@ -1,0 +1,233 @@
+"""Config 7: WAN-shaped latency — the reference's only published table,
+finally apples-to-apples.
+
+The reference's numbers come from a real 5-machine WAN deployment at
+~13 ms RTT (read p50/p95/p99.9 = 26.6/31.1/33.9 ms, write = 56/98/145 ms);
+every cluster benchmark in this repo was single-host loopback until now.
+This config reruns the reference workload shape — 5 clients × 40 keys on a
+5-replica signed cluster — under netsim's seeded 13 ms ± 1 ms full mesh
+(``NetSim.mesh(seed=8, rtt_ms=13, jitter_ms=1)``), publishing the first
+side-by-side p50/p95/p99.9 table.  The conditioning plan (per-frame
+delay/drop/reorder draws) is fully deterministic given the seed.
+
+Also carries the passthrough bound the tentpole promises: an interleaved
+paired A/B of the same throughput workload with netsim
+attached-but-disabled vs absent entirely — the ``link is None`` fast path
+must be free (acceptance: ≤2% median delta).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import math
+import statistics
+import time
+from typing import Dict, List, Optional
+
+RTT_MS = 13.0
+JITTER_MS = 1.0
+SEED = 8
+
+# The reference's WAN table (PAPER.md; SURVEY.md §6): real 5-machine
+# deployment, ~13 ms RTT, 5 clients × 40 keys.  p999 == p99.9.
+REFERENCE = {
+    "read_ms": {"p50": 26.6, "p95": 31.1, "p999": 33.9},
+    "write_ms": {"p50": 56.0, "p95": 98.0, "p999": 145.0},
+    "provenance": (
+        "reference paper's real 5-machine WAN run (~13 ms RTT), its only "
+        "published performance table; this config is the repo's first "
+        "counterpart (VERDICT r5 missing #1/#2)"
+    ),
+}
+
+
+def _pcts(samples: List[float]) -> Dict[str, float]:
+    if not samples:
+        return {"p50": float("nan"), "p95": float("nan"), "p999": float("nan")}
+    s = sorted(samples)
+
+    def at(q: float) -> float:
+        # nearest-rank: ceil(q*n)-1 (int(q*n) overshoots by one rank)
+        return round(s[min(len(s) - 1, max(0, math.ceil(q * len(s)) - 1))] * 1e3, 2)
+
+    return {"p50": at(0.50), "p95": at(0.95), "p999": at(0.999)}
+
+
+async def _wan_run(n_clients: int, keys_per_client: int, sweeps: int) -> Dict:
+    from mochi_tpu.client.txn import TransactionBuilder
+    from mochi_tpu.netsim import NetSim
+    from mochi_tpu.testing.virtual_cluster import VirtualCluster
+
+    sim = NetSim.mesh(seed=SEED, rtt_ms=RTT_MS, jitter_ms=JITTER_MS)
+    async with VirtualCluster(5, rf=4, netsim=sim) as vc:
+        read_lat: List[float] = []
+        write_lat: List[float] = []
+
+        async def worker(ci: int):
+            client = vc.client()
+            # populate off the clock (sessions + first-contact handshakes)
+            for k in range(keys_per_client):
+                await client.execute_write_transaction(
+                    TransactionBuilder().write(f"wan-{ci}-{k}", b"seed").build()
+                )
+            for s in range(sweeps):
+                for k in range(keys_per_client):
+                    key = f"wan-{ci}-{k}"
+                    t0 = time.perf_counter()
+                    await client.execute_write_transaction(
+                        TransactionBuilder().write(key, b"v%d" % s).build()
+                    )
+                    write_lat.append(time.perf_counter() - t0)
+                for k in range(keys_per_client):
+                    t0 = time.perf_counter()
+                    res = await client.execute_read_transaction(
+                        TransactionBuilder().read(f"wan-{ci}-{k}").build()
+                    )
+                    read_lat.append(time.perf_counter() - t0)
+                    assert res.operations[0].value == b"v%d" % s
+
+        t0 = time.perf_counter()
+        await asyncio.gather(*[worker(i) for i in range(n_clients)])
+        wall = time.perf_counter() - t0
+        totals = sim.totals()
+
+    return {
+        "read_ms": _pcts(read_lat),
+        "write_ms": _pcts(write_lat),
+        "read_samples": len(read_lat),
+        "write_samples": len(write_lat),
+        "wall_s": round(wall, 2),
+        "netsim_totals": totals,
+    }
+
+
+# ------------------------------------------------------- passthrough A/B
+
+
+async def _throughput_leg(netsim_disabled: bool) -> float:
+    """One small config-1-shaped leg; returns txn/s.  ``netsim_disabled``
+    attaches a NetSim with enabled=False (policy objects never handed
+    out); False runs a tree with no netsim object at all."""
+    from mochi_tpu.client.txn import TransactionBuilder
+    from mochi_tpu.netsim import NetSim
+    from mochi_tpu.testing.virtual_cluster import VirtualCluster
+
+    sim = (
+        NetSim.mesh(seed=SEED, rtt_ms=RTT_MS, jitter_ms=JITTER_MS, enabled=False)
+        if netsim_disabled
+        else None
+    )
+    async with VirtualCluster(5, rf=4, netsim=sim) as vc:
+        ops = 0
+
+        async def worker(ci: int):
+            nonlocal ops
+            client = vc.client()
+            for k in range(10):
+                key = f"pt-{ci}-{k}"
+                await client.execute_write_transaction(
+                    TransactionBuilder().write(key, b"v").build()
+                )
+                res = await client.execute_read_transaction(
+                    TransactionBuilder().read(key).build()
+                )
+                assert res.operations[0].value == b"v"
+                await client.execute_write_transaction(
+                    TransactionBuilder().delete(key).build()
+                )
+                ops += 3
+
+        t0 = time.perf_counter()
+        await asyncio.gather(*[worker(i) for i in range(4)])
+        wall = time.perf_counter() - t0
+    if sim is not None:
+        assert sim.totals()["frames"] == 0, "disabled netsim touched a frame"
+    return ops / wall
+
+
+def run_passthrough_ab(pairs: int = 9) -> Dict:
+    """Interleaved paired A/B (one disabled-netsim leg + one absent leg
+    per pair, leg ORDER alternating pair to pair): interleaving absorbs
+    host tenancy drift, alternation cancels any warmup/ordering bias.
+    The passthrough must be free — reports the median of per-pair ratios,
+    the statistic this host's ±10% run-to-run noise leaves trustworthy."""
+    ratios = []
+    disabled = []
+    absent = []
+    for i in range(pairs):
+        if i % 2 == 0:
+            d = asyncio.run(_throughput_leg(netsim_disabled=True))
+            a = asyncio.run(_throughput_leg(netsim_disabled=False))
+        else:
+            a = asyncio.run(_throughput_leg(netsim_disabled=False))
+            d = asyncio.run(_throughput_leg(netsim_disabled=True))
+        disabled.append(round(d, 1))
+        absent.append(round(a, 1))
+        ratios.append(d / a)
+    median_ratio = statistics.median(ratios)
+    return {
+        "pairs": pairs,
+        "disabled_txn_s": disabled,
+        "absent_txn_s": absent,
+        "per_pair_ratio": [round(r, 4) for r in ratios],
+        "median_ratio_disabled_over_absent": round(median_ratio, 4),
+        "median_overhead_pct": round((1.0 - median_ratio) * 100.0, 2),
+        "acceptance_le_2pct": abs(1.0 - median_ratio) <= 0.02,
+    }
+
+
+def run(
+    n_clients: int = 5,
+    keys_per_client: int = 40,
+    sweeps: int = 2,
+    ab_pairs: int = 9,  # the committed results_r08.json record's count
+) -> Dict:
+    from mochi_tpu.net import transport
+    from mochi_tpu.utils.runtime import tune_gc_for_server
+
+    tune_gc_for_server()
+    # RTT-aware timeout budget for the conditioned run (the satellite
+    # knob MOCHI_RTT_FLOOR_MS wired in net/transport.py): no Write1 may
+    # spuriously time out and double-send under the 13 ms links.
+    prev_floor = transport.RTT_FLOOR_S
+    transport.RTT_FLOOR_S = max(prev_floor, RTT_MS / 1e3)
+    try:
+        wan = asyncio.run(_wan_run(n_clients, keys_per_client, sweeps))
+    finally:
+        transport.RTT_FLOOR_S = prev_floor
+    ab = run_passthrough_ab(pairs=ab_pairs)
+    return {
+        "metric": "wan_shaped_latency_5replica_f1",
+        "value": wan["write_ms"]["p50"],
+        "unit": "ms (write p50 at 13 ms RTT)",
+        "topology": {
+            "replicas": 5,
+            "rf": 4,
+            "f": 1,
+            "clients": n_clients,
+            "keys_per_client": keys_per_client,
+            "sweeps": sweeps,
+            "mesh_rtt_ms": RTT_MS,
+            "mesh_jitter_ms": JITTER_MS,
+            "netsim_seed": SEED,
+            "rtt_floor_ms": RTT_MS,
+        },
+        **wan,
+        "reference": REFERENCE,
+        "passthrough_ab": ab,
+        "environment_caveat": (
+            "host without the `cryptography` wheel: grant/cert Ed25519 "
+            "rides the pure-Python fallback (~650 us/op, ~20x OpenSSL), "
+            "inflating the write rows and tails over the reference's "
+            "native-crypto deployment (r7 anchors: 3187.5 us/txn "
+            "wheel-less vs 295-319 OpenSSL).  The read row and the RTT "
+            "share of every row are comparable as-is; rerun on an "
+            "OpenSSL-wheel host before quoting the write comparison."
+        ),
+    }
+
+
+if __name__ == "__main__":
+    import json
+
+    print(json.dumps(run(), indent=2))

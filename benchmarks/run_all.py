@@ -28,6 +28,7 @@ CONFIG_NAMES = {
     "4": "config4_viewchange",
     "5": "config5_multichip",
     "6": "config6_bigcluster",
+    "7": "config7_wan",
 }
 
 

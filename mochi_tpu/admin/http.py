@@ -79,6 +79,22 @@ def _walk_numeric(prefix: str, obj: dict, out: list) -> None:
             out.append((key, v))
 
 
+def _prom_esc(v) -> str:
+    """Prometheus label-value escaping — ONE definition for every
+    hand-rolled exposition block in this module."""
+    return (
+        str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
+def _live_netsim(replica):
+    """The replica's NetSim iff it actually conditions traffic: an
+    enabled=False sim (the passthrough A/B leg) must leave every admin
+    surface byte-identical to a replica with no netsim at all."""
+    sim = getattr(replica, "netsim", None)
+    return sim if sim is not None and sim.enabled else None
+
+
 def _rows(d: dict) -> str:
     return "".join(
         f"<tr><td>{_esc(k)}</td><td>{_esc(v)}</td></tr>" for k, v in d.items()
@@ -204,10 +220,25 @@ class AdminServer(HttpJsonServer):
                     "config_history_stamps": sorted(r.store.config_history),
                     "member": r.server_id in cfg.servers,
                     "admin_gated": bool(cfg.admin_keys),
+                    # per-link conditioning counters when the replica runs
+                    # under netsim (docs/OPERATIONS.md "Network
+                    # conditioning"); absent key = unconditioned — which
+                    # includes enabled=False (the passthrough A/B leg must
+                    # be indistinguishable from no netsim at all)
+                    **(
+                        {"netsim": r.netsim.stats(endpoint=r.server_id)}
+                        if _live_netsim(r) is not None
+                        else {}
+                    ),
                 }
             )
         if path == "/metrics":
-            return 200, "application/json", json.dumps(r.metrics.snapshot())
+            snap = r.metrics.snapshot()
+            if _live_netsim(r) is not None:
+                # the sim's own registry (per-link counters + queue-depth
+                # gauges) rides the same snapshot machinery
+                snap["netsim"] = r.netsim.metrics.snapshot()
+            return 200, "application/json", json.dumps(snap)
         if path == "/metrics.prom":
             # Prometheus text exposition for a standard scrape stack (the
             # reference exposed Dropwizard timers via a JMX reporter,
@@ -221,11 +252,31 @@ class AdminServer(HttpJsonServer):
             samples: list = []
             _walk_numeric("", verifier_stats(r.verifier), samples)
             if samples:
-                sid = str(r.server_id).replace("\\", "\\\\").replace('"', '\\"')
+                sid = _prom_esc(r.server_id)
                 body += "# TYPE mochi_verifier gauge\n" + "".join(
                     f'mochi_verifier{{name="{k}",server="{sid}"}} {v}\n'
                     for k, v in samples
                 )
+            netsim = _live_netsim(r)
+            if netsim is not None:
+                # Per-directed-link conditioning stats as one gauge family:
+                # mochi_netsim{link="a->b",stat="dropped"} — the acceptance
+                # observable for "is the WAN shape actually applied?"
+                # Scoped to links THIS replica terminates: several replicas
+                # share one cluster-global sim in the in-process posture,
+                # and exporting the full table from each would make a
+                # multi-replica scrape over-count every link.
+                sid = _prom_esc(r.server_id)
+                lines = ["# TYPE mochi_netsim gauge\n"]
+                link_stats = netsim.stats(endpoint=r.server_id)["links"]
+                for link, stats in sorted(link_stats.items()):
+                    ln = _prom_esc(link)
+                    for stat, v in stats.items():
+                        lines.append(
+                            f'mochi_netsim{{link="{ln}",stat="{stat}",'
+                            f'server="{sid}"}} {int(v)}\n'
+                        )
+                body += "".join(lines)
             return (200, "text/plain; version=0.0.4", body)
         if path == "/" or path == "/index.html":
             cfg = r.config

@@ -89,6 +89,13 @@ class MochiDBClient:
     write_attempts: int = 16  # Write1 retry budget (seed collisions + refusals)
     refusal_retries: int = 8
     authenticate_servers: bool = True
+    # Network conditioning (mochi_tpu.netsim.NetSim): when set, every
+    # connection this client opens applies the sim's directed-link
+    # policies (label -> server and back).  netsim_label defaults to the
+    # per-run uuid client_id — pass a stable label (VirtualCluster does:
+    # "client-<i>") when run-over-run determinism matters.
+    netsim: Optional[object] = None
+    netsim_label: Optional[str] = None
     # First-attempt Write1 fan-out trimmed to a quorum (2f+1) instead of the
     # full replica set; retries widen to the full set.  Off by default: it
     # saves f requests per write but measured SLOWER on the single-core
@@ -101,7 +108,11 @@ class MochiDBClient:
     trim_write1: bool = False
 
     def __post_init__(self) -> None:
-        self.pool = RpcClientPool(default_timeout_s=self.timeout_s)
+        self.pool = RpcClientPool(
+            default_timeout_s=self.timeout_s,
+            netsim=self.netsim,
+            local_label=self.netsim_label or self.client_id,
+        )
         self.metrics = Metrics()
         self._rand = random.Random()
         # server_id -> session MAC key; Ed25519 envelope signing is the

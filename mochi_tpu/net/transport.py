@@ -37,6 +37,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import os
+import random
 import struct
 import time
 
@@ -76,6 +77,45 @@ FLUSH_MAX_DELAY_S = float(os.environ.get("MOCHI_FLUSH_MAX_DELAY_MS", "0")) / 1e3
 
 # Histogram bounds for flushed-bytes-per-write (powers of ~4 up to 1 MiB).
 _BYTES_BOUNDS = (64, 256, 1024, 4096, 16384, 65536, 262144, 1048576)
+
+# Reconnect policy (ref: MochiClient.checkChannelIsOpened retries 3×100ms,
+# MochiClient.java:110-129), env-tunable so WAN-shaped deployments can
+# widen the budget, with jittered exponential backoff so a cluster-wide
+# blip doesn't thundering-herd every client's reconnect onto one instant.
+# MOCHI_CONN_JITTER_SEED pins the jitter stream for reproducible runs
+# (netsim/config-7); unset, each process draws its own stream — identical
+# backoff schedules across processes would BE the herd.
+CONN_RETRIES = int(os.environ.get("MOCHI_CONN_RETRIES", "3"))
+CONN_BACKOFF_S = float(os.environ.get("MOCHI_CONN_BACKOFF_MS", "100")) / 1e3
+_jitter_seed = os.environ.get("MOCHI_CONN_JITTER_SEED")
+_CONN_RNG = random.Random(int(_jitter_seed)) if _jitter_seed else random.Random()
+
+
+def _backoff_delay_s(attempt: int, base_s: float, rng: Optional[random.Random] = None) -> float:
+    """attempt-th reconnect wait: ``base * 2^attempt * uniform(0.5, 1.5)``
+    (exponent capped so a long outage never waits unboundedly)."""
+    r = _CONN_RNG if rng is None else rng
+    return base_s * (1 << min(attempt, 6)) * (0.5 + r.random())
+
+
+# Request-timeout RTT floor: callers size timeouts for loopback (where a
+# round trip is microseconds); under a 13 ms WAN link a tight budget times
+# out a perfectly healthy request and the retry doubles the load.  With
+# MOCHI_RTT_FLOOR_MS set, every send_and_receive/fan_out budget is raised
+# to at least RTT_TIMEOUT_MULT round trips (connect + request + verify
+# queueing all ride the same links).  Default 0: behavior unchanged.
+RTT_FLOOR_S = float(os.environ.get("MOCHI_RTT_FLOOR_MS", "0")) / 1e3
+RTT_TIMEOUT_MULT = float(os.environ.get("MOCHI_RTT_TIMEOUT_MULT", "8"))
+
+
+def apply_rtt_floor(timeout_s: float) -> float:
+    """Raise a caller's timeout to the configured multiple of the RTT
+    floor (no-op at the default floor of 0).  A non-positive timeout means
+    "no waiting" (ADVICE r3) and is never raised."""
+    if timeout_s <= 0:
+        return timeout_s
+    floor = RTT_FLOOR_S * RTT_TIMEOUT_MULT
+    return timeout_s if timeout_s >= floor else floor
 
 
 class ConnectionNotReady(Exception):
@@ -125,6 +165,15 @@ class _FramedProtocol(asyncio.Protocol):
     def __init__(self) -> None:
         self._buf = bytearray()
         self.transport: Optional[asyncio.Transport] = None
+        # netsim seams (mochi_tpu.netsim.LinkPolicy or None).  The
+        # INITIATOR of a connection owns both directions of its logical
+        # link: egress conditions the frames we send (A->B), ingress
+        # conditions the frames we receive (B->A) — so server responses
+        # are WAN-shaped too, with zero server-side peer labeling.  None
+        # (the default everywhere outside a conditioned cluster) keeps the
+        # hot path a single attribute test.
+        self.egress_link = None
+        self.ingress_link = None
 
     # -- subclass surface
     def frame_received(self, frame: bytes) -> None:  # pragma: no cover
@@ -135,7 +184,24 @@ class _FramedProtocol(asyncio.Protocol):
 
     def send_frame(self, payload: bytes) -> None:
         assert self.transport is not None
-        self.transport.write(_LEN.pack(len(payload)) + payload)
+        data = _LEN.pack(len(payload)) + payload
+        link = self.egress_link
+        if link is None:
+            self.transport.write(data)
+        else:
+            link.send(self._conditioned_write, data)
+
+    def _conditioned_write(self, data: bytes) -> bool:
+        """Delayed-egress delivery: the link may fire after this
+        connection died — a frame for a closed transport is simply lost
+        (exactly what the modeled network would have done with it).
+        Returns False for that case so the link counts it ``lost``, not
+        ``delivered`` (the evidence records lean on delivered==frames)."""
+        t = self.transport
+        if t is None or t.is_closing():
+            return False
+        t.write(data)
+        return True
 
     # -- flow control: a peer that won't read our responses stops being
     # allowed to feed us requests (bounded memory per connection).
@@ -158,6 +224,7 @@ class _FramedProtocol(asyncio.Protocol):
         buf += data
         pos = 0
         n = len(buf)
+        ingress = self.ingress_link
         while n - pos >= 4:
             (length,) = _LEN.unpack_from(buf, pos)
             if length > MAX_FRAME:
@@ -170,7 +237,14 @@ class _FramedProtocol(asyncio.Protocol):
                 break
             frame = bytes(buf[pos + 4 : end])
             pos = end
-            self.frame_received(frame)
+            # Ingress conditioning happens at FRAME granularity (parse
+            # first, then delay/drop/reorder delivery): the sim sits above
+            # a real ordered socket, so dropping raw bytes would corrupt
+            # framing rather than model message loss.
+            if ingress is None:
+                self.frame_received(frame)
+            else:
+                ingress.send(self.frame_received, frame)
             if self.transport is None or self.transport.is_closing():
                 break
         if pos:
@@ -633,8 +707,11 @@ class _RpcClientProtocol(_FramedProtocol):
 
 
 class _Connection:
-    def __init__(self, info: ServerInfo):
+    def __init__(self, info: ServerInfo, links=None):
         self.info = info
+        # (egress, ingress) LinkPolicy pair from NetSim.link_pair, or None:
+        # attached to every protocol this connection (re)creates.
+        self.links = links
         self.pending: Dict[str, asyncio.Future] = {}
         self._proto: Optional[_RpcClientProtocol] = None
         self._connect_lock = asyncio.Lock()
@@ -647,15 +724,22 @@ class _Connection:
             and not self._proto.transport.is_closing()
         )
 
-    async def ensure_connected(self, retries: int = 3, delay_s: float = 0.1) -> None:
+    async def ensure_connected(
+        self, retries: Optional[int] = None, delay_s: Optional[float] = None
+    ) -> None:
         # ref: MochiClient.checkChannelIsOpened retries 3×100ms then throws
-        # (MochiClient.java:110-129).
+        # (MochiClient.java:110-129); count/backoff env-tunable
+        # (MOCHI_CONN_RETRIES / MOCHI_CONN_BACKOFF_MS) with jittered
+        # exponential backoff — see _backoff_delay_s.
+        if retries is None:
+            retries = CONN_RETRIES
+        base_s = CONN_BACKOFF_S if delay_s is None else delay_s
         async with self._connect_lock:
             if self.connected:
                 return
             loop = asyncio.get_running_loop()
             last_exc: Optional[Exception] = None
-            for _ in range(retries):
+            for attempt in range(retries):
                 try:
                     if self.info.is_unix:
                         _, proto = await loop.create_unix_connection(
@@ -667,11 +751,15 @@ class _Connection:
                             self.info.host,
                             self.info.port,
                         )
+                    if self.links is not None:
+                        proto.egress_link, proto.ingress_link = self.links
                     self._proto = proto
                     return
                 except OSError as exc:
                     last_exc = exc
-                    await asyncio.sleep(delay_s)
+                    if attempt + 1 < retries:  # no dead-time sleep after the
+                        # final attempt — the exception is the next step
+                        await asyncio.sleep(_backoff_delay_s(attempt, base_s))
             raise ConnectionNotReady(f"cannot reach {self.info.url}") from last_exc
 
     def _on_connection_lost(self) -> None:
@@ -696,7 +784,7 @@ class _Connection:
         self.pending[env.msg_id] = fut
         try:
             self._proto.send_frame(encode_envelope(env))
-            return await asyncio.wait_for(fut, timeout_s)
+            return await asyncio.wait_for(fut, apply_rtt_floor(timeout_s))
         finally:
             self.pending.pop(env.msg_id, None)
 
@@ -709,16 +797,35 @@ class _Connection:
 
 class RpcClientPool:
     """One connection per target server, created lazily
-    (ref: ``MochiMessaging.java:33-45``)."""
+    (ref: ``MochiMessaging.java:33-45``).
 
-    def __init__(self, default_timeout_s: float = 10.0):
+    ``netsim``/``local_label``: when this pool belongs to a conditioned
+    cluster (mochi_tpu.netsim), each new connection gets the (egress,
+    ingress) policy pair for the directed links ``local_label ->
+    info.server_id`` and back — WAN shaping, loss and partitions then
+    apply to every request this pool sends and every response it awaits.
+    """
+
+    def __init__(
+        self,
+        default_timeout_s: float = 10.0,
+        netsim=None,
+        local_label: Optional[str] = None,
+    ):
         self.default_timeout_s = default_timeout_s
+        self.netsim = netsim
+        self.local_label = local_label
         self._connections: Dict[str, _Connection] = {}
 
     def _conn(self, info: ServerInfo) -> _Connection:
         conn = self._connections.get(info.url)
         if conn is None:
-            conn = _Connection(info)
+            links = None
+            if self.netsim is not None:
+                links = self.netsim.link_pair(
+                    self.local_label or "client", info.server_id
+                )
+            conn = _Connection(info, links=links)
             self._connections[info.url] = conn
         return conn
 
@@ -776,8 +883,11 @@ async def fan_out(
     """
     targets = list(targets)
     # `is None` (not falsy-or): an explicit timeout_s=0 means "no waiting",
-    # not "use the default" (ADVICE r3).
+    # not "use the default" (ADVICE r3).  The RTT floor then raises
+    # loopback-sized budgets to >= RTT_TIMEOUT_MULT round trips under
+    # conditioned/WAN links (no-op at the default floor of 0).
     timeout = pool.default_timeout_s if timeout_s is None else timeout_s
+    timeout = apply_rtt_floor(timeout)
     out: Dict[str, Envelope | Exception] = {}
 
     # Steady state: every target connection is open, so each request is a

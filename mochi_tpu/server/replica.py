@@ -88,6 +88,7 @@ class MochiReplica:
         snapshot_path: Optional[str] = None,
         snapshot_interval_s: float = 0.0,
         shed_lag_ms: float = 30.0,
+        netsim=None,
     ):
         self.server_id = server_id
         self.config = config
@@ -110,8 +111,12 @@ class MochiReplica:
             batch_handler=self.handle_batch,
             metrics=self.metrics,
         )
+        # Network conditioning (mochi_tpu.netsim.NetSim or None): held for
+        # the peer pool's link policies and the admin surfaces (/status
+        # "netsim", /metrics.prom mochi_netsim gauges).
+        self.netsim = netsim
         # server->server pool (state transfer); lazily connected
-        self.peer_pool = RpcClientPool()
+        self.peer_pool = RpcClientPool(netsim=netsim, local_label=server_id)
         self._sync_tasks: set = set()
         self._pending_sync_keys: set = set()
         self._sync_worker: Optional[asyncio.Task] = None

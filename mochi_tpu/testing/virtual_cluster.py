@@ -47,6 +47,12 @@ class VirtualCluster:
         # without the monitor.
         shed_lag_ms: float = 0.0,
         uds_dir: Optional[str] = None,
+        # Network conditioning (mochi_tpu.netsim.NetSim): a topology spec —
+        # e.g. NetSim.mesh(seed=8, rtt_ms=13, jitter_ms=1) for "full mesh,
+        # 13 ms ± 1 ms RTT" — threaded into every replica's peer pool and
+        # every vc.client() SDK instance, with the event schedule armed at
+        # cluster start.  None (default): unconditioned loopback as before.
+        netsim=None,
     ):
         self.n_servers = n_servers
         self.rf = rf
@@ -54,6 +60,7 @@ class VirtualCluster:
         self.require_client_auth = require_client_auth
         self.host = host
         self.shed_lag_ms = shed_lag_ms
+        self.netsim = netsim
         # Unix-domain sockets instead of loopback TCP (per-replica socket
         # files under this dir): skips the TCP/IP stack on the kernel send
         # path, the measured cost floor for single-host clusters
@@ -88,6 +95,9 @@ class VirtualCluster:
             )
             return self
 
+        if self.netsim is not None:
+            self.netsim.ensure_started()  # arm the link-event schedule at t=0
+
         server_ids = [f"server-{i}" for i in range(self.n_servers)]
         self.keypairs = {sid: generate_keypair() for sid in server_ids}
 
@@ -115,6 +125,7 @@ class VirtualCluster:
                 host=host_for(sid),
                 port=0,
                 shed_lag_ms=self.shed_lag_ms,
+                netsim=self.netsim,
             )
             await replica.start()
             self.replicas.append(replica)
@@ -130,6 +141,15 @@ class VirtualCluster:
 
     def client(self, **kwargs) -> MochiDBClient:
         assert self.config is not None, "cluster not started"
+        if self.netsim is not None and "netsim" not in kwargs:
+            kwargs["netsim"] = self.netsim
+        if kwargs.get("netsim") is not None:
+            # Stable sequential labels (client-0, client-1, ...), not the
+            # per-run uuid client_id: link RNG streams are seeded from the
+            # (seed, src, dst) triple, and determinism requires the labels
+            # to be identical run over run — also for callers passing
+            # their own netsim= explicitly.
+            kwargs.setdefault("netsim_label", f"client-{len(self._clients)}")
         client = MochiDBClient(config=self.config, **kwargs)
         self.client_keys[client.client_id] = client.keypair.public_key
         self._clients.append(client)
@@ -157,6 +177,7 @@ class VirtualCluster:
             # same endpoint the config advertises (UDS path or TCP host)
             host=self.config.servers[server_id].host,
             port=port,
+            netsim=self.netsim,
         )
         await fresh.start()
         self.replicas[self.replicas.index(old)] = fresh
@@ -173,6 +194,8 @@ class VirtualCluster:
             await replica.close()
         self.replicas.clear()
         self._clients.clear()
+        if self.netsim is not None:
+            self.netsim.close()  # cancel schedule timers + in-flight frames
         if self._owns_uds_dir and self.uds_dir is not None:
             import functools
             import shutil

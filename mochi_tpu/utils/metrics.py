@@ -148,12 +148,18 @@ class Metrics:
         self.timers: Dict[str, Timer] = defaultdict(Timer)
         self.counters: Dict[str, int] = defaultdict(int)
         self.histograms: Dict[str, Histogram] = {}
+        # Last-write-wins instantaneous values (queue depths, link states —
+        # things that go *down* as well as up, which counters cannot).
+        self.gauges: Dict[str, float] = {}
 
     def timer(self, name: str) -> _TimerCtx:
         return _TimerCtx(self.timers[name])
 
     def mark(self, name: str, n: int = 1) -> None:
         self.counters[name] += n
+
+    def set_gauge(self, name: str, value: float) -> None:
+        self.gauges[name] = value
 
     def histogram(self, name: str, bounds=Histogram.DEFAULT_BOUNDS) -> Histogram:
         """Get-or-create; ``bounds`` only applies on first creation (a
@@ -168,6 +174,7 @@ class Metrics:
         return {
             "timers": {name: t.snapshot() for name, t in self.timers.items()},
             "counters": dict(self.counters),
+            "gauges": dict(self.gauges),
             "histograms": {
                 name: h.snapshot() for name, h in self.histograms.items()
             },
@@ -203,6 +210,11 @@ class Metrics:
         for name, n in sorted(self.counters.items()):
             lab = f'name="{esc(name)}"' + (f",{base}" if base else "")
             lines.append(f"mochi_counter_total{{{lab}}} {n}")
+        if self.gauges:
+            lines.append("# TYPE mochi_gauge gauge")
+            for name, v in sorted(self.gauges.items()):
+                lab = f'name="{esc(name)}"' + (f",{base}" if base else "")
+                lines.append(f"mochi_gauge{{{lab}}} {v:g}")
         if self.histograms:
             lines.append("# TYPE mochi_histogram histogram")
             for name, h in sorted(self.histograms.items()):
