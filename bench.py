@@ -444,6 +444,12 @@ def _measure() -> dict:
         "unit": "sigs/sec",
         "vs_baseline": round(best_rate / cpu_rate, 3),
         "platform": dev.platform,
+        # Which HOST engine produced cpu_*_sigs_per_sec (and every replica-
+        # inline verify on this machine): openssl / native-c / pure-python.
+        # Machine-readable provenance for the standing wheel-less-host
+        # caveat — the "CPU baseline" of a record is not comparable across
+        # engines (ISSUE 5 satellite).
+        "host_crypto_engine": keys.host_crypto_engine(),
         "impl": best_impl,
         "best_batch": best_batch,
         "pipelined_sigs_per_sec_by_depth": pipeline,

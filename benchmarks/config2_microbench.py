@@ -12,7 +12,8 @@ import time
 from typing import Dict, List
 
 
-def run(batch_sizes=(1024, 2048, 4096, 8192), iters: int = 3) -> Dict:
+def run(batch_sizes=(1024, 2048, 4096, 8192), iters: int = 3,
+        big_batch: int = 65536) -> Dict:
     import jax
     import numpy as np
 
@@ -52,7 +53,9 @@ def run(batch_sizes=(1024, 2048, 4096, 8192), iters: int = 3) -> Dict:
     # MAX_BUCKET VMEM peak with every chunk launched before any readback —
     # raw 16k+/64k programs spill VMEM and regress, which is why the
     # chunking exists; BASELINE config 2 range still covered).
-    big = 65536
+    big = big_batch
+    if not big:  # --smoke harness pass: skip the 64k production-path leg
+        return _record(points, items, keys, batch_sizes)
     items64 = []
     for i in range(big):
         msg = b"micro64k %d" % i
@@ -74,6 +77,10 @@ def run(batch_sizes=(1024, 2048, 4096, 8192), iters: int = 3) -> Dict:
         }
     )
 
+    return _record(points, items, keys, batch_sizes)
+
+
+def _record(points, items, keys, batch_sizes) -> Dict:
     # CPU baseline (sampled)
     sample = items[:512]
     t0 = time.perf_counter()

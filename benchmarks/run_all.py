@@ -31,6 +31,22 @@ CONFIG_NAMES = {
     "7": "config7_wan",
 }
 
+# --smoke: tiny-count kwargs per config — a seconds-scale pass whose only
+# purpose is catching benchmark-harness rot at PR time (import errors,
+# schema drift, APIs the benchmarks call that the tree no longer has).
+# Wired into tier-1 as tests/test_bench_smoke.py; numbers produced under
+# these counts are MEANINGLESS and are never published (main() refuses
+# --smoke --publish).
+SMOKE_KWARGS = {
+    "1": dict(n_clients=2, keys_per_client=2, sweeps=1, verifier="cpu"),
+    "2": dict(batch_sizes=(256,), iters=1, big_batch=0),
+    "3": dict(n=4, f=1, n_ops=64, batch=256),
+    "4": dict(n=4, f=1, rounds=1),
+    "5": dict(batch_per_device=256, n_groups=8, iters=1),
+    "6": dict(writers=2, writes_per_writer=1, verifier="cpu", shapes=(4,)),
+    "7": dict(n_clients=2, keys_per_client=2, sweeps=1, ab_pairs=0),
+}
+
 
 def _run_child(key: str) -> None:
     import jax
@@ -49,10 +65,52 @@ def _run_child(key: str) -> None:
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
     import importlib
 
-    os.environ.setdefault("MOCHI_BENCH_FULL", "1")  # battery: full evidence
+    smoke = os.environ.get("MOCHI_BENCH_SMOKE") == "1"
+    if smoke:
+        # tiny-count harness-rot pass: never publish full-evidence legs
+        os.environ["MOCHI_BENCH_FULL"] = ""
+    else:
+        os.environ.setdefault("MOCHI_BENCH_FULL", "1")  # battery: full evidence
     mod = importlib.import_module(f"benchmarks.{CONFIG_NAMES[key]}")
-    rec = mod.run()
+    if smoke:
+        # Two levers make "all 7 configs in seconds" possible on a fresh
+        # host: (1) jax.disable_jit() — jitted wrappers run their python
+        # bodies, so nothing pays an XLA:CPU compile; (2) the DEVICE
+        # Ed25519 program (curve.verify_prepared*) is stubbed to all-true
+        # — its eager evaluation is a ~100k-dispatch curve ladder, and
+        # smoke is a harness-rot detector (imports, prepare packing,
+        # quorum plumbing, record schema), not a verdict test: the real
+        # engines are differentially tested in tier-1 proper
+        # (tests/test_native_ed25519.py, test_crypto_jax.py).
+        import jax.numpy as jnp
+
+        from mochi_tpu.crypto import comb, curve
+
+        def _stub_verify(*args, **kwargs):
+            return jnp.ones((args[0].shape[0],), dtype=jnp.bool_)
+
+        def _stub_comb(table_flat, key_idx, *args, **kwargs):
+            return jnp.ones((key_idx.shape[0],), dtype=jnp.bool_)
+
+        curve.verify_prepared = _stub_verify
+        curve.verify_prepared_packed = _stub_verify
+        comb.verify_comb_prepared = _stub_comb
+        comb._verify_comb_jit = _stub_comb  # the import-time jit wrapper
+        with jax.disable_jit():
+            rec = mod.run(**SMOKE_KWARGS.get(key, {}))
+    else:
+        rec = mod.run()
     rec["config"] = key
+    # Host-crypto provenance on EVERY record (ISSUE 5 satellite): which
+    # engine served host-side Ed25519 during this run — the difference
+    # between a comparable write row and a ~20x-inflated one is no longer
+    # a prose caveat.
+    try:
+        from mochi_tpu.crypto.keys import host_crypto_engine
+
+        rec["host_crypto_engine"] = host_crypto_engine()
+    except Exception:
+        pass
     try:
         rec["platform"] = jax.devices()[0].platform
     except Exception:
@@ -85,6 +143,13 @@ def main(argv) -> None:
         _run_child(argv[1])
         return
     publish = "--publish" in argv
+    if "--smoke" in argv:
+        if publish:
+            print("--smoke numbers are meaningless; refusing --publish",
+                  file=sys.stderr)
+            sys.exit(2)
+        os.environ["MOCHI_BENCH_SMOKE"] = "1"  # children read it
+        argv = [a for a in argv if a != "--smoke"]
     # --require-tpu: exit 3 unless every config ran on the chip.  The
     # battery banks this step as done-for-the-round on rc==0; without the
     # flag a CPU-fallback run exits 0 (the publish guard only skips
@@ -167,7 +232,8 @@ def merge_published(baseline: dict, results: list, round_n: str) -> list:
             k: v
             for k, v in r.items()
             if k in ("metric", "value", "unit", "vs_baseline", "error",
-                     "platform", "read_p50_ms", "write_p50_ms")
+                     "platform", "host_crypto_engine",
+                     "read_p50_ms", "write_p50_ms")
             and v is not None
         }
         entry["source"] = f"benchmarks/results_r{round_n}.json"

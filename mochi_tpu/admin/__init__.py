@@ -7,4 +7,4 @@ free asyncio HTTP/1.1 server exposing replica status, metrics snapshots, and
 cluster topology as JSON plus a small status page.
 """
 
-from .http import AdminServer  # noqa: F401
+from .http import AdminServer, ClientAdminServer  # noqa: F401

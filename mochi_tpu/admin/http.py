@@ -49,6 +49,8 @@ quorum={quorum} &middot; {member}</p>
 <table>{verifier_rows}</table>
 <h2>Batching</h2>
 <table>{batching_rows}</table>
+<h2>Fan-out</h2>
+<table>{fanout_rows}</table>
 <p class="muted">{sessions} live client sessions &middot;
 admin-gated: {admin_gated} &middot; page auto-refreshes</p>
 <ul>
@@ -99,6 +101,110 @@ def _rows(d: dict) -> str:
     return "".join(
         f"<tr><td>{_esc(k)}</td><td>{_esc(v)}</td></tr>" for k, v in d.items()
     )
+
+
+# ------------------------------------------------- fan-out observability
+#
+# Early-quorum fan-outs (net/transport.fan_out) record per-TARGET-replica
+# straggler evidence into the INITIATOR's metrics registry:
+#   fanout-straggler-ms.<sid>   histogram: lateness past the quorum point
+#   fanout.late-response.<sid>  counter: answered after the early return
+#   fanout.straggler-error.<sid>  counter: leg failed while draining
+#   fanout.straggler-timeout.<sid> counter: never answered in budget
+#   fanout.early-return         counter: fan-outs that returned at quorum
+# The extractors below are registry-generic, so every admin surface — the
+# replica shell, the client shell, any future initiator — renders the same
+# shape (docs/OPERATIONS.md §4d "Write-path latency").
+
+_FANOUT_COUNTER_STATS = (
+    "late-response",
+    "straggler-error",
+    "straggler-timeout",
+    "straggler-drain-cancelled",
+)
+
+
+def _fanout_stats(metrics) -> dict:
+    """``{"early_returns": n, "peers": {sid: {...}}}`` from a registry's
+    ``fanout*`` entries; empty peers dict when the process never fanned
+    out (the surface then stays compact rather than vanishing)."""
+    peers: dict = {}
+    for name, h in metrics.histograms.items():
+        if name.startswith("fanout-straggler-ms."):
+            peers.setdefault(name[len("fanout-straggler-ms."):], {})[
+                "straggler_ms"
+            ] = h.snapshot()
+    for stat in _FANOUT_COUNTER_STATS:
+        prefix = f"fanout.{stat}."
+        for name, n in metrics.counters.items():
+            if name.startswith(prefix):
+                peers.setdefault(name[len(prefix):], {})[
+                    stat.replace("-", "_")
+                ] = n
+    return {
+        "early_returns": metrics.counters.get("fanout.early-return", 0),
+        "peers": peers,
+    }
+
+
+def _fanout_prom(metrics, label_key: str, label_val: str) -> str:
+    """``mochi_fanout{peer=...,stat=...}`` exposition block ('' when the
+    registry holds no fan-out evidence).  Counters plus straggler-lateness
+    count/mean; the full lateness HISTOGRAM already rides the standard
+    ``mochi_histogram`` family under name="fanout-straggler-ms.<sid>"."""
+    st = _fanout_stats(metrics)
+    if not st["peers"] and not st["early_returns"]:
+        return ""
+    base = f'{label_key}="{_prom_esc(label_val)}"'
+    lines = [
+        "# TYPE mochi_fanout gauge\n",
+        f'mochi_fanout{{peer="",stat="early_returns",{base}}} '
+        f'{st["early_returns"]}\n',
+    ]
+    for peer, stats in sorted(st["peers"].items()):
+        pn = _prom_esc(peer)
+        for stat, v in sorted(stats.items()):
+            if isinstance(v, dict):  # histogram snapshot -> count + mean
+                lines.append(
+                    f'mochi_fanout{{peer="{pn}",stat="straggler_ms_count",'
+                    f"{base}}} {v['count']}\n"
+                )
+                if v["mean"] is not None:
+                    lines.append(
+                        f'mochi_fanout{{peer="{pn}",stat="straggler_ms_mean",'
+                        f"{base}}} {v['mean']}\n"
+                    )
+            else:
+                lines.append(
+                    f'mochi_fanout{{peer="{pn}",stat="{stat}",{base}}} {v}\n'
+                )
+    return "".join(lines)
+
+
+def _fanout_rows(metrics) -> str:
+    """The "/" page Fan-out table: one row per target replica."""
+    st = _fanout_stats(metrics)
+    if not st["peers"]:
+        return (
+            "<tr><td>(no early-quorum fan-out traffic from this process)"
+            "</td><td></td></tr>"
+        )
+    rows = [
+        f"<tr><td>early returns</td><td>{st['early_returns']}</td></tr>"
+    ]
+    for peer, stats in sorted(st["peers"].items()):
+        h = stats.get("straggler_ms")
+        parts = []
+        if h:
+            parts.append(f"late n={h['count']} mean={h['mean']} ms")
+        for stat in ("late_response", "straggler_error", "straggler_timeout",
+                     "straggler_drain_cancelled"):
+            if stat in stats:
+                parts.append(f"{stat}={stats[stat]}")
+        rows.append(
+            f"<tr><td>{_esc(peer)}</td><td>{_esc(' '.join(parts))}</td></tr>"
+        )
+    return "".join(rows)
 
 
 def _batching_rows(metrics) -> str:
@@ -217,6 +323,10 @@ class AdminServer(HttpJsonServer):
                         for name, h in sorted(r.metrics.histograms.items())
                     },
                     "sessions": len(getattr(r, "_sessions", {})),
+                    # early-quorum fan-out evidence from THIS process's
+                    # registry (peers empty on a pure responder — the
+                    # key stays so dashboards need no existence probe)
+                    "fanout": _fanout_stats(r.metrics),
                     "config_history_stamps": sorted(r.store.config_history),
                     "member": r.server_id in cfg.servers,
                     "admin_gated": bool(cfg.admin_keys),
@@ -257,6 +367,7 @@ class AdminServer(HttpJsonServer):
                     f'mochi_verifier{{name="{k}",server="{sid}"}} {v}\n'
                     for k, v in samples
                 )
+            body += _fanout_prom(r.metrics, "server", r.server_id)
             netsim = _live_netsim(r)
             if netsim is not None:
                 # Per-directed-link conditioning stats as one gauge family:
@@ -296,7 +407,80 @@ class AdminServer(HttpJsonServer):
                 store_rows=_rows(r.store.stats()),
                 verifier_rows=_rows(verifier_stats(r.verifier)),
                 batching_rows=_batching_rows(r.metrics),
+                fanout_rows=_fanout_rows(r.metrics),
                 sessions=len(getattr(r, "_sessions", {})),
                 admin_gated=bool(cfg.admin_keys),
+            )
+        return 404, "application/json", json.dumps({"error": "not found"})
+
+
+_CLIENT_PAGE = """<!doctype html>
+<html><head><title>mochi-tpu client {client_id}</title>
+<meta http-equiv="refresh" content="3">
+<style>
+ body {{ font-family: system-ui, sans-serif; margin: 2rem auto; max-width: 46rem;
+         color: #1a1a2e; }}
+ table {{ border-collapse: collapse; margin: 0.6rem 0 1.2rem; }}
+ th, td {{ text-align: left; padding: 0.25rem 0.9rem 0.25rem 0; }}
+ th {{ border-bottom: 1px solid #ccc; font-weight: 600; }}
+ .muted {{ color: #667; }}
+</style></head>
+<body>
+<h1>mochi-tpu client <code>{client_id}</code></h1>
+<p class="muted">SDK coordinator shell &middot; early-quorum
+{early_quorum} &middot; {sessions} live sessions</p>
+<h2>Fan-out</h2>
+<table>{fanout_rows}</table>
+<h2>Timers</h2>
+<table>{timer_rows}</table>
+</body></html>
+"""
+
+
+class ClientAdminServer(HttpJsonServer):
+    """Operator shell for a long-lived SDK client process — the INITIATOR
+    side of every fan-out, which is where the early-quorum straggler
+    evidence accrues (a replica's shell only shows fan-outs it initiates).
+    Same endpoints as the replica shell where they make sense: ``/status``
+    (identity + fanout + timers JSON), ``/metrics`` (full snapshot),
+    ``/metrics.prom`` (standard families + ``mochi_fanout``), ``/``."""
+
+    def __init__(self, client, host: str = "127.0.0.1", port: int = 0):
+        super().__init__(host, port)
+        self.client = client
+
+    def _route(self, path: str):
+        c = self.client
+        m = c.metrics
+        if path == "/status":
+            return 200, "application/json", json.dumps(
+                {
+                    "client_id": c.client_id,
+                    "early_quorum": bool(c.early_quorum),
+                    "sessions": len(c._sessions),
+                    "fanout": _fanout_stats(m),
+                    "timers": {
+                        name: t.snapshot() for name, t in sorted(m.timers.items())
+                    },
+                }
+            )
+        if path == "/metrics":
+            return 200, "application/json", json.dumps(m.snapshot())
+        if path == "/metrics.prom":
+            body = m.to_prometheus({"client": c.client_id})
+            body += _fanout_prom(m, "client", c.client_id)
+            return 200, "text/plain; version=0.0.4", body
+        if path == "/" or path == "/index.html":
+            timer_rows = "".join(
+                f"<tr><td>{_esc(name)}</td><td>n={t.count} "
+                f"p50={t.percentile(50) * 1e3:.2f} ms</td></tr>"
+                for name, t in sorted(m.timers.items())
+            )
+            return 200, "text/html", _CLIENT_PAGE.format(
+                client_id=_esc(c.client_id),
+                early_quorum="on" if c.early_quorum else "off",
+                sessions=len(c._sessions),
+                fanout_rows=_fanout_rows(m),
+                timer_rows=timer_rows or "<tr><td>(no traffic)</td><td></td></tr>",
             )
         return 404, "application/json", json.dumps({"error": "not found"})

@@ -20,10 +20,11 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import random
 import uuid
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..cluster.config import (
     CONFIG_CLIENT_PREFIX,
@@ -61,6 +62,7 @@ from ..protocol import (
 )
 from ..utils.metrics import Metrics
 from .errors import InconsistentRead, InconsistentWrite, RequestRefused
+from .txn import GrantAssembler, QuorumTally
 import time
 
 LOG = logging.getLogger(__name__)
@@ -96,6 +98,18 @@ class MochiDBClient:
     # "client-<i>") when run-over-run determinism matters.
     netsim: Optional[object] = None
     netsim_label: Optional[str] = None
+    # Early-quorum fan-outs (the PR-5 write-path tentpole): every phase
+    # returns the moment a signature/MAC-verified, consistent 2f+1
+    # agreement exists — Write2 dispatches at the 2f+1st consistent grant,
+    # commit acks return at the 2f+1st consistent answer, and the
+    # stragglers drain in the background into per-replica histograms
+    # (net/transport._drain_stragglers).  The final tallies still re-check
+    # the full quorum conditions over whatever was returned, so this knob
+    # trades NOTHING in safety; off = wait out the full replica set as
+    # before (kill switch: MOCHI_EARLY_QUORUM=0).
+    early_quorum: bool = field(
+        default_factory=lambda: os.environ.get("MOCHI_EARLY_QUORUM", "1") != "0"
+    )
     # First-attempt Write1 fan-out trimmed to a quorum (2f+1) instead of the
     # full replica set; retries widen to the full set.  Off by default: it
     # saves f requests per write but measured SLOWER on the single-core
@@ -327,8 +341,18 @@ class MochiDBClient:
         payload_factory,
         _retry: bool = True,
         targets: Optional[List[Tuple[str, ServerInfo]]] = None,
+        arrived: Optional[Callable[[str, object], bool]] = None,
     ) -> Dict[str, object]:
-        """Fan a payload to the replica set; keep only authentic responses."""
+        """Fan a payload to the replica set; keep only authentic responses.
+
+        ``arrived`` (early-quorum path): a payload-level predicate called
+        per response AS IT LANDS — behind an authenticity gate, so only
+        MAC/signature-verified payloads can vote.  When it returns True the
+        fan-out returns immediately with the responses so far; transport
+        drains the stragglers in the background.  Verification therefore
+        runs verify-as-arrived, overlapping the remaining targets' network
+        wait, instead of verify-at-tally after the slowest replica.
+        """
         if targets is None:
             targets = self._targets(transaction)
         now = time.monotonic()
@@ -342,12 +366,26 @@ class MochiDBClient:
             await asyncio.gather(
                 *(self._ensure_session(sid, info) for sid, info in missing)
             )
+        quorum_done = None
+        # sids the predicate already authenticated this fan-out — the
+        # post-filter below skips re-verifying those (the second HMAC —
+        # or worse, a second uncached Ed25519 verify on session-less
+        # envelopes — would be pure waste on exactly the hot path this
+        # predicate exists to shorten).
+        auth_ok: set = set()
+        if arrived is not None and self.early_quorum:
+            def quorum_done(sid: str, res: object) -> bool:
+                if not isinstance(res, Envelope) or not self._authentic(sid, res):
+                    return False
+                auth_ok.add(sid)
+                return arrived(sid, res.payload)
         results = await fan_out(
             self.pool,
             targets,
             lambda msg_id, sid: self._envelope(payload_factory(), msg_id, sid),
             self.timeout_s,
             metrics=self.metrics,
+            quorum_done=quorum_done,
         )
         out: Dict[str, object] = {}
         stale_sessions = []
@@ -355,7 +393,7 @@ class MochiDBClient:
             if isinstance(res, Exception):
                 LOG.debug("no response from %s: %s", sid, res)
                 continue
-            if not self._authentic(sid, res):
+            if sid not in auth_ok and not self._authentic(sid, res):
                 LOG.warning("dropping unauthenticated response claiming to be %s", sid)
                 continue
             payload = res.payload
@@ -371,8 +409,16 @@ class MochiDBClient:
         if stale_sessions and _retry:
             for sid in stale_sessions:
                 self._sessions.pop(sid, None)
+            # arrived=None on the stale-session retry: the caller's
+            # tracker (QuorumTally/GrantAssembler) already holds votes
+            # from THIS attempt's discarded responses, so reusing it
+            # could fire the predicate before the retry's own responses
+            # reach quorum — the authoritative tally would then raise on
+            # a thin dict a full wait would have satisfied.  The retry
+            # is rare (replica restarted mid-session); it just waits out
+            # the full set.
             return await self._fan_out(
-                transaction, payload_factory, _retry=False, targets=targets
+                transaction, payload_factory, _retry=False, targets=targets,
             )
         return out
 
@@ -455,10 +501,35 @@ class MochiDBClient:
                 # n-way fan-out pays one payload-tree encode, not n
                 # (messages.Envelope._six_bytes).
                 read_payload = ReadToServer(self.client_id, transaction, nonce)
+                # Early-quorum: stop waiting the moment every op has 2f+1
+                # agreeing in-set answers (same vote rules as the tally
+                # below, which stays authoritative over the returned dict).
+                tally = QuorumTally(
+                    [
+                        set(self.config.replica_set_for_key(op.key))
+                        for op in transaction.operations
+                    ],
+                    self.config.quorum,
+                )
+
+                def _read_fp(op_res):
+                    if op_res.status == Status.WRONG_SHARD:
+                        return None
+                    return (bytes(op_res.value or b""), op_res.existed)
+
+                def read_arrived(sid: str, payload: object) -> bool:
+                    if (
+                        not isinstance(payload, ReadFromServer)
+                        or payload.nonce != nonce
+                    ):
+                        return False
+                    return tally.add(sid, payload.result.operations, _read_fp)
+
                 responses = await self._fan_out(
                     transaction,
                     lambda: read_payload,
                     targets=self._quorum_targets(transaction) if trim else None,
+                    arrived=read_arrived,
                 )
             reads = {
                 sid: p
@@ -698,6 +769,23 @@ class MochiDBClient:
                 w1_payload = Write1ToServer(
                     self.client_id, write1_txn, seed, txn_hash
                 )
+                # Pipelined Write1 -> Write2: the assembler folds each
+                # authenticated grant in AS IT ARRIVES and fires the moment
+                # a timestamp-consistent per-key 2f+1 subset exists — the
+                # fan-out then returns and Write2 dispatches immediately,
+                # overlapping certificate assembly with the residual grant
+                # arrivals (drained in the background).
+                assembler = GrantAssembler(
+                    lambda oks: self._quorum_grant_subset(transaction, oks)
+                )
+
+                def w1_arrived(sid: str, payload: object) -> bool:
+                    return (
+                        isinstance(payload, Write1OkFromServer)
+                        and payload.multi_grant.server_id == sid
+                        and assembler.add(payload.multi_grant)
+                    )
+
                 with self.metrics.timer("write1-phase"):
                     responses = await self._fan_out(
                         write1_txn,
@@ -707,6 +795,7 @@ class MochiDBClient:
                             if attempt == 0 and self.trim_write1
                             else None
                         ),
+                        arrived=w1_arrived,
                     )
                 oks: List[MultiGrant] = []
                 for sid, p in responses.items():
@@ -715,6 +804,9 @@ class MochiDBClient:
                 # Proceed as soon as a timestamp-consistent 2f+1 subset
                 # exists; refusals/outliers from up to f servers (contention,
                 # lag, Byzantine skew) must not block an honest quorum.
+                # Recomputed here over the post-filter responses even when
+                # the assembler fired (authoritative; the assembler is a
+                # liveness signal — see client/txn.py).
                 chosen = self._quorum_grant_subset(transaction, oks)
                 if chosen is not None and not self._is_admin_txn(transaction):
                     # Admin (config/archive) certificates keep ALL grants: a
@@ -835,12 +927,37 @@ class MochiDBClient:
         # was re-encoded per target (96% of envelope encode cost, round-5
         # profile); the payload-level mcode cache makes this one encode.
         w2_payload = Write2ToServer(certificate, transaction)
+        # Early-quorum commit: stop waiting at the 2f+1st consistent
+        # verified answer per op (Write2 was still SENT to the full set —
+        # every replica applies; only the client's wait is quorum-bound).
+        # _tally_write2 below re-checks >= 2f+1 over the returned dict, so
+        # a commit can never be accepted on fewer verified responses.
+        tally = QuorumTally(
+            [
+                set(self.config.replica_set_for_key(op.key))
+                for op in transaction.operations
+            ],
+            self.config.quorum,
+        )
+
+        def _w2_fp(op_res):
+            if op_res.status == Status.WRONG_SHARD:
+                return None
+            return (bytes(op_res.value or b""), op_res.status)
+
+        def w2_arrived(sid: str, payload: object) -> bool:
+            if not isinstance(payload, Write2AnsFromServer):
+                return False
+            return tally.add(sid, payload.result.operations, _w2_fp)
+
         # Stage-timed for the commit breakdown (config-6): the fan-out wait
-        # spans send-to-all through last-response/timeout — it CONTAINS each
-        # replica's verify wait + store apply plus the wire/loop time; the
-        # tally is pure client CPU after the last response lands.
+        # now spans send-to-all through the QUORUM point (stragglers drain
+        # off the clock) — it CONTAINS each replica's verify wait + store
+        # apply plus the wire/loop time; the tally is pure client CPU.
         with self.metrics.timer("write2-fanout-wait"):
-            responses = await self._fan_out(transaction, lambda: w2_payload)
+            responses = await self._fan_out(
+                transaction, lambda: w2_payload, arrived=w2_arrived
+            )
         with self.metrics.timer("write2-tally"):
             return self._tally_write2(transaction, responses)
 

@@ -1,10 +1,91 @@
-"""Fluent transaction builder (ref: ``client/TransactionBuilder.java:14-57``)."""
+"""Transaction-level client helpers: the fluent builder
+(ref: ``client/TransactionBuilder.java:14-57``) plus the incremental
+quorum-tracking state machines behind the early-quorum write path —
+:class:`GrantAssembler` (Write1 certificate assembly as grants arrive) and
+:class:`QuorumTally` (per-op 2f+1 agreement as read/Write2 answers arrive).
+
+Both trackers are LIVENESS devices only: they decide when the client may
+stop *waiting*.  The authoritative safety checks — the timestamp-consistent
+grant subset and the per-op >= 2f+1 tally — are re-run by
+``client.MochiDBClient`` over the returned responses, so a tracker bug can
+delay a transaction but can never commit one on thin evidence.
+"""
 
 from __future__ import annotations
 
-from typing import List, Optional
+from collections import defaultdict
+from typing import Callable, Dict, List, Optional, Sequence, Set
 
-from ..protocol import Action, Operation, Transaction
+from ..protocol import Action, MultiGrant, Operation, Transaction
+
+
+class GrantAssembler:
+    """Incremental write-certificate assembly (the Write1 half of the
+    pipelined write path): MultiGrants feed in as responses arrive, and
+    :meth:`add` reports the moment a timestamp-consistent per-key 2f+1
+    subset exists — the signal to dispatch Write2 immediately instead of
+    waiting out the full replica set.
+
+    ``subset_fn`` is the client's authoritative subset computation
+    (``MochiDBClient._quorum_grant_subset`` closed over the transaction),
+    so assembly-time and tally-time consistency can never diverge.  Grants
+    dedup by issuing server (latest wins) — a replica re-answering after a
+    session retry must not double its timestamp vote.
+    """
+
+    def __init__(self, subset_fn: Callable[[List[MultiGrant]], Optional[List[MultiGrant]]]):
+        self._subset_fn = subset_fn
+        self._by_server: Dict[str, MultiGrant] = {}
+        self.chosen: Optional[List[MultiGrant]] = None
+
+    def add(self, grant: MultiGrant) -> bool:
+        """Feed one authenticated MultiGrant; True once a consistent
+        quorum subset exists (recorded in ``chosen``)."""
+        self._by_server[grant.server_id] = grant
+        if self.chosen is None:
+            self.chosen = self._subset_fn(list(self._by_server.values()))
+        return self.chosen is not None
+
+
+class QuorumTally:
+    """Incremental per-operation agreement counter for read / Write2
+    responses: one vote per replica, restricted to each operation's
+    replica set, grouped by a caller-supplied result fingerprint.
+    :meth:`add` returns True once EVERY operation has some fingerprint
+    with >= ``quorum`` votes — the earliest moment the caller's own
+    authoritative tally over the same responses can possibly succeed."""
+
+    def __init__(self, rsets: Sequence[Set[str]], quorum: int):
+        self.rsets = list(rsets)
+        self.quorum = quorum
+        self._counts = [defaultdict(int) for _ in self.rsets]
+        self._seen: Set[str] = set()
+        self._op_done = [False] * len(self.rsets)
+        self._pending_ops = len(self.rsets)
+
+    def add(self, sid: str, operations: Sequence, fingerprint: Callable) -> bool:
+        """Tally one replica's per-op results.  ``fingerprint(op_result)``
+        returns a hashable agreement key, or None to skip the op (e.g. a
+        WRONG_SHARD filler)."""
+        if sid in self._seen:
+            return self.satisfied
+        self._seen.add(sid)
+        for i, rset in enumerate(self.rsets):
+            if sid not in rset or i >= len(operations):
+                continue
+            fp = fingerprint(operations[i])
+            if fp is None:
+                continue
+            counts = self._counts[i]
+            counts[fp] += 1
+            if not self._op_done[i] and counts[fp] >= self.quorum:
+                self._op_done[i] = True
+                self._pending_ops -= 1
+        return self._pending_ops == 0
+
+    @property
+    def satisfied(self) -> bool:
+        return self._pending_ops == 0
 
 
 class TransactionBuilder:

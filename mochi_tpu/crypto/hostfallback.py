@@ -22,6 +22,19 @@ property of the *fallback* (same posture as the reference's total absence
 of crypto); production deployments install ``cryptography``.  The
 variable-time operations are confined to this module so the constant-time
 checker's scope stays meaningful everywhere else.
+
+Native-C engine: on hosts with a toolchain but no ``cryptography`` wheel,
+:func:`verify` and :func:`sign` route through ``native/hbatch.c`` — full
+Ed25519 verification (Straus ladder on 51-bit limbs, the same cofactorless
+check) and deterministic RFC 8032 signing (doubling-free fixed-base comb,
+bit-identical output to this module and OpenSSL — the replica's own-grant
+re-sign-and-compare depends on that).  Differential suite:
+``tests/test_native_ed25519.py`` (forgeries, non-canonical encodings,
+low-order points, sign determinism).  That cuts the ~1.2 ms pure-Python
+verify / ~0.4 ms sign to tens of microseconds; key expansion, X25519 and
+the curve math below stay pure Python, the automatic fallback when no C
+toolchain exists and the single implementation the native engine is
+tested against.
 """
 
 from __future__ import annotations
@@ -280,10 +293,25 @@ def public_from_seed(seed: bytes) -> bytes:
     return _expand_seed(bytes(seed))[2]
 
 
+@lru_cache(maxsize=4096)
+def _native_sign_material(seed: bytes) -> Tuple[bytes, bytes, bytes]:
+    """(clamped scalar LE bytes, prefix, public) for the native signer —
+    the byte-form twin of _expand_seed's cached int expansion."""
+    a, prefix, pub = _expand_seed(seed)
+    return a.to_bytes(32, "little"), prefix, pub
+
+
 def sign(private_seed: bytes, message: bytes) -> bytes:
     """RFC 8032 §5.1.6 — bit-compatible with OpenSSL's deterministic sign
-    (the replica's own-grant re-sign-and-compare depends on determinism)."""
-    a, prefix, pub = _expand_seed(bytes(private_seed))
+    (the replica's own-grant re-sign-and-compare depends on determinism).
+    Routed through the native engine when built; the pure-Python path
+    below is the reference implementation it must match byte-for-byte."""
+    seed = bytes(private_seed)
+    mod = _native_engine()
+    if mod is not None:
+        a_bytes, prefix, pub = _native_sign_material(seed)
+        return mod.sign_prepared(a_bytes, prefix, pub, bytes(message))
+    a, prefix, pub = _expand_seed(seed)
     r = int.from_bytes(hashlib.sha512(prefix + message).digest(), "little") % _L
     r_bytes = _compress(_mul_base(r))
     k = int.from_bytes(
@@ -333,12 +361,67 @@ def _verify_cached(public_key: bytes, signature: bytes, h_digest: bytes) -> bool
     return ok
 
 
+# ------------------------------------------------------- native-C engine
+#
+# native/hbatch.c's verify_batch: the full cofactorless check in C, built
+# lazily with the system toolchain (same discipline as the codec/mcode and
+# the batched-h path).  None when no toolchain / MOCHI_NO_NATIVE=1 — the
+# pure-Python engine above then carries verification as before.
+
+_NATIVE_UNSET = object()
+_native = _NATIVE_UNSET
+
+
+def _native_engine():
+    global _native
+    if _native is _NATIVE_UNSET:
+        try:
+            from ..native import get_hbatch
+
+            mod = get_hbatch()
+        except Exception:  # pragma: no cover - import-cycle/loader breakage
+            mod = None
+        # an older prebuilt _hbatch.so (pre-engine) lacks the symbols;
+        # treat it as no native engine rather than failing calls
+        _native = (
+            mod
+            if mod is not None
+            and hasattr(mod, "verify_batch")
+            and hasattr(mod, "sign_prepared")
+            else None
+        )
+    return _native
+
+
+def has_native() -> bool:
+    """Whether :func:`verify` routes through the native-C engine."""
+    return _native_engine() is not None
+
+
+# Same cache shape and key as _verify_cached (the two engines are verdict-
+# identical, but separate caches keep "which engine answered" honest for
+# the differential suite): 160 bytes per entry, and in-process clusters
+# dedup the rf-way re-check of identical certificate grants.
+@lru_cache(maxsize=4096)
+def _native_verify_cached(public_key: bytes, signature: bytes, h_digest: bytes) -> bool:
+    mod = _native_engine()
+    return mod.verify_batch(
+        public_key, signature, mod.reduce512(h_digest)
+    ) == b"\x01"
+
+
 def verify(public_key: bytes, message: bytes, signature: bytes) -> bool:
     """Cofactorless ``[S]B == R + [h]A``.  Callers (``keys.verify``) have
     already enforced canonical encodings (y < p, S < L, lengths)."""
     public_key = bytes(public_key)
     signature = bytes(signature)
     h_digest = hashlib.sha512(signature[:32] + public_key + bytes(message)).digest()
+    if (
+        len(public_key) == 32
+        and len(signature) == 64
+        and _native_engine() is not None
+    ):
+        return _native_verify_cached(public_key, signature, h_digest)
     return _verify_cached(public_key, signature, h_digest)
 
 

@@ -121,12 +121,30 @@ def _canonical(public_key: bytes, signature: bytes) -> bool:
     return y_a < _P and y_r < _P and s < _L
 
 
+def host_crypto_engine() -> str:
+    """Which engine :func:`verify` routes to on THIS host: ``"openssl"``
+    (the ``cryptography`` wheel), ``"native-c"`` (the lazily-built
+    ``native/hbatch.c`` verification engine), or ``"pure-python"`` (the
+    :mod:`~mochi_tpu.crypto.hostfallback` bignum engine).  Benchmark
+    records stamp this so the recurring "wheel-less host inflates write
+    latency" caveat is machine-readable provenance, not prose
+    (benchmarks/run_all.py, bench.py)."""
+    if _HAVE_HOST_CRYPTO:
+        return "openssl"
+    try:
+        return "native-c" if _fallback().has_native() else "pure-python"
+    except Exception:  # pragma: no cover - loader breakage
+        return "pure-python"
+
+
 def register_known_signers(pubs) -> bool:
     """Pre-promote known signers (cluster replica identities) in the host
     verify engine; returns whether the hint reached an engine that uses it.
 
     With OpenSSL present this is a no-op (its verify has no per-signer
-    state worth warming).  On wheel-less hosts the pure-Python engine keeps
+    state worth warming), and likewise with the native-C engine (its
+    Straus ladder rebuilds the per-item table in-call; no signer state).
+    On toolchain-less wheel-less hosts the pure-Python engine keeps
     per-signer fixed-window tables (:mod:`~mochi_tpu.crypto.hostfallback`,
     the host analog of the device comb) that normally require two verified
     signatures to earn; pre-promotion makes the FIRST certificate check
@@ -135,7 +153,10 @@ def register_known_signers(pubs) -> bool:
     """
     if _HAVE_HOST_CRYPTO:
         return False
-    return _fallback().prime_signers(pubs)
+    fb = _fallback()
+    if fb.has_native():
+        return False
+    return fb.prime_signers(pubs)
 
 
 def verify(public_key: bytes, message: bytes, signature: bytes) -> bool:

@@ -5,8 +5,11 @@ JDK MessageDigest intrinsics — SURVEY.md §2.9); the TPU framework keeps its
 Python control plane but moves hot host loops to C extensions:
 
 * ``_mcode`` — the canonical wire/signing codec (mcode.c).
-* ``_hbatch`` — batched SHA-512(R||A||M) mod L, the per-item half of the
-  verifier's host prepare (hbatch.c).
+* ``_hbatch`` — batched SHA-512(R||A||M) mod L (the per-item half of the
+  verifier's host prepare) AND the full host Ed25519 verification engine
+  (``verify_batch``: Straus ladder on 51-bit limbs) that
+  ``crypto/keys.verify`` routes to on hosts without the OpenSSL wheel
+  (hbatch.c).
 
 Build model: compiled on first use into this package directory with the
 system compiler (cc/gcc), cached by source mtime; if no toolchain is

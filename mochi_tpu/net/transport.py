@@ -44,7 +44,7 @@ import time
 from typing import Awaitable, Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
 from ..cluster.config import ServerInfo
-from ..utils.metrics import LATENCY_BOUNDS_S
+from ..utils.metrics import LATENCY_BOUNDS_S, STRAGGLER_BOUNDS_MS
 from ..protocol import (
     Envelope,
     HelloToServer,
@@ -816,6 +816,15 @@ class RpcClientPool:
         self.netsim = netsim
         self.local_label = local_label
         self._connections: Dict[str, _Connection] = {}
+        # Background straggler drains spawned by early-quorum fan_outs:
+        # pool-owned so close() can cancel them (and so the tasks hold a
+        # strong reference — a GC'd drain would silently stop feeding the
+        # straggler metrics and leak pending-future entries).
+        self._straggler_tasks: set = set()
+
+    def _track_straggler(self, task) -> None:
+        self._straggler_tasks.add(task)
+        task.add_done_callback(self._straggler_tasks.discard)
 
     def _conn(self, info: ServerInfo) -> _Connection:
         conn = self._connections.get(info.url)
@@ -837,6 +846,13 @@ class RpcClientPool:
         )
 
     async def close(self) -> None:
+        for task in list(self._straggler_tasks):
+            task.cancel()
+        if self._straggler_tasks:
+            await asyncio.gather(
+                *list(self._straggler_tasks), return_exceptions=True
+            )
+        self._straggler_tasks.clear()
         for conn in self._connections.values():
             await conn.close()
         self._connections.clear()
@@ -863,12 +879,88 @@ def new_msg_id() -> str:
     return out.hex()
 
 
+async def _drain_stragglers(
+    fut_info: Dict[asyncio.Future, Tuple[str, Optional[str], Optional[_Connection]]],
+    deadline: float,
+    metrics,
+    t_quorum: float,
+) -> None:
+    """Background half of an early-quorum fan-out: keep awaiting the
+    targets the caller no longer needs, so late responses are observed —
+    never silently dropped.  Each arrival feeds the per-replica
+    ``fanout-straggler-ms.<sid>`` histogram (lateness past the quorum
+    point) and a ``fanout.late-response.<sid>`` counter; a target that
+    never answers inside the original budget is cancelled and counted as
+    ``fanout.straggler-timeout.<sid>``.  Keeping the futures registered in
+    ``conn.pending`` until they resolve is also connection health: the
+    eventual response frame correlates normally instead of tripping the
+    uncorrelated-response warning path."""
+    loop = asyncio.get_running_loop()
+    pending = set(fut_info)
+    cancelled = False
+    try:
+        while pending:
+            remaining = deadline - loop.time()
+            if remaining <= 0:
+                break
+            done, pending = await asyncio.wait(
+                pending, timeout=remaining, return_when=asyncio.FIRST_COMPLETED
+            )
+            if not done:
+                break
+            late_ms = (loop.time() - t_quorum) * 1e3
+            for fut in done:
+                sid, msg_id, conn = fut_info[fut]
+                if conn is not None:
+                    conn.pending.pop(msg_id, None)
+                if metrics is None:
+                    continue
+                exc = None if fut.cancelled() else fut.exception()
+                if fut.cancelled() or exc is not None:
+                    # connection died / leg failed — the failure already
+                    # counts toward reconnect health; tag it here too so
+                    # "slowest replica" vs "dead replica" is answerable
+                    metrics.mark(f"fanout.straggler-error.{sid}")
+                else:
+                    metrics.mark(f"fanout.late-response.{sid}")
+                    metrics.histogram(
+                        f"fanout-straggler-ms.{sid}", STRAGGLER_BOUNDS_MS
+                    ).observe(late_ms)
+    except asyncio.CancelledError:
+        cancelled = True  # pool.close() mid-drain, NOT a replica fault
+        raise
+    finally:
+        for fut in pending:
+            sid, msg_id, conn = fut_info[fut]
+            if conn is not None:
+                conn.pending.pop(msg_id, None)
+            # a future that completed while the interrupted wait was
+            # resuming is an answer, not a timeout
+            answered = fut.done() and not fut.cancelled()
+            answered_ok = answered and fut.exception() is None
+            fut.cancel()
+            if metrics is None:
+                continue
+            if answered_ok:
+                metrics.mark(f"fanout.late-response.{sid}")
+            elif cancelled and not answered:
+                # clean shutdown cancelled the drain: in-flight targets
+                # must NOT accrue "never answered in budget" evidence —
+                # operators read straggler-timeout as replica health
+                metrics.mark(f"fanout.straggler-drain-cancelled.{sid}")
+            elif answered:
+                metrics.mark(f"fanout.straggler-error.{sid}")
+            else:
+                metrics.mark(f"fanout.straggler-timeout.{sid}")
+
+
 async def fan_out(
     pool: RpcClientPool,
     targets: Iterable[Tuple[str, ServerInfo]],
     make_envelope: Callable[..., Envelope],
     timeout_s: Optional[float] = None,
     metrics=None,
+    quorum_done: Optional[Callable[[str, object], bool]] = None,
 ) -> Dict[str, "Envelope | Exception"]:
     """Send one envelope per target concurrently; gather results or exceptions
     per server id (ref: ``Utils.sendMessageToServers`` + ``busyWaitForFutures``,
@@ -880,6 +972,18 @@ async def fan_out(
     build+serialize+send loop as ``fanout-serialize-send`` — the "fan-out
     serialization" slice of the commit breakdown, distinct from the
     response wait that follows.
+
+    ``quorum_done`` makes the fan-out QUORUM-bound instead of straggler-
+    bound: it is called once per arrival as ``(server_id, envelope_or_
+    exception)`` and when it returns True the fan-out returns immediately
+    with everything received so far.  The still-outstanding targets are
+    handed to a pool-owned background drain (:func:`_drain_stragglers`)
+    that records their lateness — late responses feed metrics and resolve
+    their pending-map entries; they are never silently dropped.  The
+    predicate sees raw transport results (the caller authenticates inside
+    it), and its verdict is advisory for LIVENESS only: callers re-tally
+    the returned dict, so a buggy predicate can slow a caller down or
+    return extra responses, never forge agreement.
     """
     targets = list(targets)
     # `is None` (not falsy-or): an explicit timeout_s=0 means "no waiting",
@@ -892,11 +996,13 @@ async def fan_out(
 
     # Steady state: every target connection is open, so each request is a
     # synchronous frame write plus one bare future — the whole fan-out then
-    # parks on a single asyncio.wait (one timer, no per-target task).  The
-    # per-target task/wait_for formulation costs ~10 scheduled callbacks per
+    # parks on asyncio.wait (no per-target task).  The per-target
+    # task/wait_for formulation costs ~10 scheduled callbacks per
     # transaction at cluster rates.
     loop = asyncio.get_running_loop()
-    waiting: List[Tuple[str, asyncio.Future, str, _Connection]] = []
+    # future/task -> (sid, msg_id or None, connection or None): msg_id+conn
+    # only for fast-path bare futures, whose pending-map entry we own.
+    fut_info: Dict[asyncio.Future, Tuple[str, Optional[str], Optional[_Connection]]] = {}
     slow: List[Tuple[str, ServerInfo]] = []
     send_t0 = time.perf_counter() if metrics is not None else 0.0
     for sid, info in targets:
@@ -914,7 +1020,7 @@ async def fan_out(
             conn.pending.pop(env.msg_id, None)
             out[sid] = exc
             continue
-        waiting.append((sid, fut, env.msg_id, conn))
+        fut_info[fut] = (sid, env.msg_id, conn)
     if metrics is not None:
         metrics.timers["fanout-serialize-send"].record(
             time.perf_counter() - send_t0
@@ -932,41 +1038,88 @@ async def fan_out(
 
     # Slow path (unconnected targets: dial + handshake + request, each leg
     # bounded by `timeout` inside send_and_receive) runs CONCURRENTLY with
-    # the fast-path wait below — serially, one down replica would stretch
-    # the whole fan-out to ~2x the budget (ADVICE r3).
-    slow_task = (
-        asyncio.ensure_future(
-            asyncio.gather(
-                *(one(sid, info) for sid, info in slow), return_exceptions=True
-            )
-        )
-        if slow
-        else None
-    )
+    # the fast-path wait — serially, one down replica would stretch the
+    # whole fan-out to ~2x the budget (ADVICE r3).
+    for sid, info in slow:
+        fut_info[asyncio.ensure_future(one(sid, info))] = (sid, None, None)
 
+    # Results already in `out` (send failures) can satisfy a predicate too
+    # — same exception posture as the arrival loop: a predicate bug must
+    # never break the fan-out itself.
+    early = False
+    if quorum_done is not None:
+        for sid, res in out.items():
+            try:
+                if quorum_done(sid, res):
+                    early = True
+                    break
+            except Exception:
+                LOG.exception("fan-out predicate failed for %s", sid)
+
+    deadline = loop.time() + timeout
+    pending = set(fut_info)
+    handed_off = False
     try:
-        if waiting:
-            await asyncio.wait([f for _, f, _, _ in waiting], timeout=timeout)
-            for sid, fut, msg_id, conn in waiting:
-                conn.pending.pop(msg_id, None)
-                if fut.done():
-                    exc = fut.exception()
-                    out[sid] = exc if exc is not None else fut.result()
+        while pending and not early:
+            remaining = deadline - loop.time()
+            if remaining <= 0:
+                break
+            done, pending = await asyncio.wait(
+                pending, timeout=remaining, return_when=asyncio.FIRST_COMPLETED
+            )
+            if not done:
+                break
+            for fut in done:
+                sid, msg_id, conn = fut_info[fut]
+                if conn is not None:
+                    conn.pending.pop(msg_id, None)
+                if fut.cancelled():  # e.g. a concurrent connection close
+                    res: object = ConnectionNotReady(f"request to {sid} cancelled")
                 else:
-                    fut.cancel()
-                    out[sid] = TimeoutError(f"no response from {sid} in {timeout}s")
-
-        if slow_task is not None:
-            # Already ran alongside the fast-path wait; each leg is
-            # internally deadline-bounded, so this completes ~immediately
-            # after it.
-            slow_results = await slow_task
-            for (sid, _), res in zip(slow, slow_results):
+                    exc = fut.exception()
+                    res = exc if exc is not None else fut.result()
                 out[sid] = res
+                # Verify-as-arrived: the predicate runs per arrival, so
+                # authentication overlaps the remaining targets' network
+                # wait instead of queueing behind the full fan-out.
+                if not early and quorum_done is not None:
+                    try:
+                        if quorum_done(sid, res):
+                            early = True
+                    except Exception:
+                        LOG.exception("fan-out predicate failed for %s", sid)
+        if pending and early:
+            # Quorum satisfied: hand the stragglers to the background
+            # drain and return what we have.
+            if metrics is not None:
+                metrics.mark("fanout.early-return")
+            task = loop.create_task(
+                _drain_stragglers(
+                    {f: fut_info[f] for f in pending},
+                    deadline,
+                    metrics,
+                    loop.time(),
+                )
+            )
+            pool._track_straggler(task)
+            handed_off = True
+        else:
+            for fut in pending:
+                sid, msg_id, conn = fut_info[fut]
+                if conn is not None:
+                    conn.pending.pop(msg_id, None)
+                fut.cancel()
+                out[sid] = TimeoutError(f"no response from {sid} in {timeout}s")
+        pending = set()
         return out
     finally:
-        # Structured concurrency: if the fan-out itself is cancelled (caller
-        # deadline, shutdown) the detached slow-path task must not keep
-        # dialing replicas and sending envelopes in the background.
-        if slow_task is not None and not slow_task.done():
-            slow_task.cancel()
+        # Structured concurrency: if the fan-out itself is cancelled
+        # (caller deadline, shutdown), outstanding sends must not keep
+        # dialing replicas in the background — unless they were already
+        # handed to the pool-owned straggler drain, which owns them now.
+        if pending and not handed_off:
+            for fut in pending:
+                sid, msg_id, conn = fut_info[fut]
+                if conn is not None:
+                    conn.pending.pop(msg_id, None)
+                fut.cancel()

@@ -117,6 +117,12 @@ LATENCY_BOUNDS_S = (
     0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
 )
 
+# Fan-out straggler lateness (milliseconds AFTER the quorum was already
+# satisfied — not absolute RTT): sub-ms buckets catch loopback jitter,
+# the top buckets catch a replica pinned behind a WAN hiccup or a stalled
+# event loop (net/transport.fan_out early-quorum drain).
+STRAGGLER_BOUNDS_MS = (0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 1000)
+
 
 class _TimerCtx:
     """Hand-rolled timing context.

@@ -99,10 +99,12 @@ class CpuVerifier(SignatureVerifier):
 
     def register_signers(self, pubs: Sequence[bytes]) -> bool:
         # With OpenSSL installed this is a no-op (per-verify cost is already
-        # ~120 us); on wheel-less hosts it pre-promotes the pure-Python
-        # engine's per-signer window tables (the host analog of the device
-        # comb) so the FIRST certificate check runs combed instead of
-        # paying two ~380-addition ladders to earn promotion.
+        # ~120 us), and likewise on hosts running the native-C engine (no
+        # per-signer state); on toolchain-less wheel-less hosts it
+        # pre-promotes the pure-Python engine's per-signer window tables
+        # (the host analog of the device comb) so the FIRST certificate
+        # check runs combed instead of paying two ~380-addition ladders to
+        # earn promotion.
         return crypto_keys.register_known_signers(pubs)
 
 
@@ -452,6 +454,12 @@ def verifier_stats(verifier) -> dict:
     /status and the verifier service's --admin-port — so key names cannot
     drift between them."""
     st: dict = {"type": type(verifier).__name__ if verifier else "CpuVerifier"}
+    if verifier is None or isinstance(verifier, CpuVerifier):
+        # Which host engine actually runs this node's inline verifies —
+        # openssl / native-c / pure-python.  The same provenance string the
+        # benchmark records stamp (ISSUE 5 satellite), so an operator can
+        # tell a wheel-less node from a scrape instead of from latency.
+        st["host_crypto_engine"] = crypto_keys.host_crypto_engine()
     for attr in (
         "batches_flushed",
         "items_verified",

@@ -67,3 +67,79 @@ async def _main():
             assert "Membership" in body and "Store" in body and "Verifier" in body
         finally:
             await admin.close()
+
+
+def test_fanout_surfaces_and_client_admin_shell():
+    asyncio.run(asyncio.wait_for(_fanout_main(), timeout=60))
+
+
+async def _fanout_main():
+    from mochi_tpu.admin import ClientAdminServer
+    from mochi_tpu.utils.metrics import STRAGGLER_BOUNDS_MS
+
+    async with VirtualCluster(4, rf=4) as vc:
+        client = vc.client()
+        await client.execute_write_transaction(
+            TransactionBuilder().write("fanout-key", b"v").build()
+        )
+        loop = asyncio.get_running_loop()
+
+        # replica /status always carries the fanout key (empty peers on a
+        # pure responder — dashboards need no existence probe)
+        admin = AdminServer(vc.replicas[0], port=0)
+        await admin.start()
+        try:
+            _, _, body = await loop.run_in_executor(
+                None, _get, admin.bound_port, "/status"
+            )
+            doc = json.loads(body)
+            assert doc["fanout"] == {"early_returns": 0, "peers": {}}
+        finally:
+            await admin.close()
+
+        # the client shell surfaces the INITIATOR-side evidence: populate
+        # the exact names transport's straggler drain records
+        m = client.metrics
+        m.mark("fanout.early-return")
+        m.mark("fanout.late-response.server-2")
+        m.mark("fanout.straggler-timeout.server-3")
+        m.histogram("fanout-straggler-ms.server-2", STRAGGLER_BOUNDS_MS).observe(3.1)
+        cadmin = ClientAdminServer(client, port=0)
+        await cadmin.start()
+        try:
+            port = cadmin.bound_port
+            _, ctype, body = await loop.run_in_executor(None, _get, port, "/status")
+            assert ctype == "application/json"
+            doc = json.loads(body)
+            assert doc["client_id"] == client.client_id
+            assert doc["fanout"]["early_returns"] == 1
+            peers = doc["fanout"]["peers"]
+            assert peers["server-2"]["late_response"] == 1
+            assert peers["server-2"]["straggler_ms"]["count"] == 1
+            assert peers["server-3"]["straggler_timeout"] == 1
+
+            _, ctype, body = await loop.run_in_executor(
+                None, _get, port, "/metrics.prom"
+            )
+            assert ctype.startswith("text/plain")
+            assert 'mochi_fanout{peer="server-2",stat="late_response"' in body
+            assert 'stat="early_returns"' in body
+            # the full lateness histogram rides the standard family
+            assert 'name="fanout-straggler-ms.server-2"' in body
+
+            _, ctype, body = await loop.run_in_executor(None, _get, port, "/")
+            assert ctype == "text/html"
+            assert "Fan-out" in body and "server-2" in body
+        finally:
+            await cadmin.close()
+
+        # replica "/" page gained the Fan-out table too
+        admin2 = AdminServer(vc.replicas[1], port=0)
+        await admin2.start()
+        try:
+            _, _, body = await loop.run_in_executor(
+                None, _get, admin2.bound_port, "/"
+            )
+            assert "Fan-out" in body
+        finally:
+            await admin2.close()

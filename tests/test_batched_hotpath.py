@@ -586,3 +586,108 @@ def test_standing_rules_host_record_reads_results_file():
     # r07 as of this round (wheel-less-host record; caveat lives in-file)
     assert src == "benchmarks/results_r07.json"
     assert rate == pytest.approx(1.09)
+
+
+# ------------------------------------------------- early-quorum safety pins
+#
+# PR-5 tentpole: the early-quorum predicates are LIVENESS devices — a
+# predicate that lies (fires before a real quorum exists) may only slow or
+# fail a transaction, never let the client accept a result on fewer than
+# 2f+1 verified responses.  Both halves pinned: the Write2 tally and the
+# Write1 grant assembly.
+
+
+def _staggered_sim():
+    """Per-replica delays spread far enough apart that each response
+    arrives in its own event-loop wake — on bare loopback every reply
+    lands in ONE wake and even a lying predicate sees the full set, which
+    would void these pins."""
+    from mochi_tpu.netsim import LinkEvent, LinkSpec, NetSim
+
+    sim = NetSim.mesh(seed=17, rtt_ms=2.0)
+    return sim, [
+        LinkEvent(0.0, "set", pat_src, pat_dst, LinkSpec(delay_ms=d / 2.0))
+        for i, d in enumerate((4.0, 30.0, 60.0, 90.0))
+        for pat_src, pat_dst in ((f"server-{i}", "*"), ("*", f"server-{i}"))
+    ]
+
+
+def test_lying_write2_predicate_cannot_commit_below_quorum(monkeypatch):
+    from mochi_tpu.client import txn as txn_mod
+    from mochi_tpu.client.errors import InconsistentWrite, RequestRefused
+    from mochi_tpu.client.txn import TransactionBuilder
+    from mochi_tpu.testing.virtual_cluster import VirtualCluster
+
+    async def main():
+        sim, events = _staggered_sim()
+        async with VirtualCluster(4, rf=4, netsim=sim) as vc:  # f=1, quorum=3
+            client = vc.client(write_attempts=3, refusal_retries=2)
+            await client.execute_write_transaction(
+                TransactionBuilder().write("pin-warm", b"w").build()
+            )
+            for ev in events:
+                sim.apply_event(ev)
+            # QuorumTally.add lies: "satisfied" at the FIRST response, so
+            # every fan-out early-returns with ~1 reply.
+            monkeypatch.setattr(
+                txn_mod.QuorumTally, "add", lambda self, *a, **k: True
+            )
+            with pytest.raises((InconsistentWrite, RequestRefused)):
+                await client.execute_write_transaction(
+                    TransactionBuilder().write("pin-key", b"v").build()
+                )
+
+    asyncio.run(asyncio.wait_for(main(), timeout=60))
+
+
+def test_lying_grant_assembler_cannot_build_thin_certificate(monkeypatch):
+    from mochi_tpu.client import txn as txn_mod
+    from mochi_tpu.client.errors import RequestRefused
+    from mochi_tpu.client.txn import TransactionBuilder
+    from mochi_tpu.testing.virtual_cluster import VirtualCluster
+
+    async def main():
+        sim, events = _staggered_sim()
+        async with VirtualCluster(4, rf=4, netsim=sim) as vc:
+            client = vc.client(write_attempts=3, refusal_retries=2)
+            await client.execute_write_transaction(
+                TransactionBuilder().write("pin-warm2", b"w").build()
+            )
+            for ev in events:
+                sim.apply_event(ev)
+            # GrantAssembler.add lies without recording a chosen subset:
+            # Write1 early-returns on the first grant, and the client's
+            # authoritative recomputation must refuse to certify.
+            monkeypatch.setattr(
+                txn_mod.GrantAssembler, "add", lambda self, grant: True
+            )
+            with pytest.raises(RequestRefused):
+                await client.execute_write_transaction(
+                    TransactionBuilder().write("pin-key2", b"v").build()
+                )
+
+    asyncio.run(asyncio.wait_for(main(), timeout=60))
+
+
+def test_early_quorum_kill_switch_disables_predicates():
+    from mochi_tpu.client.txn import TransactionBuilder
+    from mochi_tpu.testing.virtual_cluster import VirtualCluster
+
+    async def main():
+        async with VirtualCluster(4, rf=4) as vc:
+            client = vc.client(early_quorum=False)
+            await client.execute_write_transaction(
+                TransactionBuilder().write("ks", b"v").build()
+            )
+            res = await client.execute_read_transaction(
+                TransactionBuilder().read("ks").build()
+            )
+            assert res.operations[0].value == b"v"
+            # no predicate ever installed: the early-return counter and
+            # straggler families must be absent
+            assert "fanout.early-return" not in client.metrics.counters
+            assert not any(
+                n.startswith("fanout") for n in client.metrics.histograms
+            )
+
+    asyncio.run(asyncio.wait_for(main(), timeout=60))

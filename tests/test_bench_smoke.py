@@ -1,0 +1,51 @@
+"""Benchmark-harness smoke (tier-1): ``run_all --smoke`` must produce an
+error-free, provenance-stamped record from ALL 7 configs in seconds.
+
+This is rot detection, not measurement: a benchmark that imports a moved
+module, calls a renamed API, or drifts its record schema fails HERE, at
+PR time, instead of during the next publish battery.  Smoke numbers are
+meaningless by construction (tiny counts, eager execution, stubbed device
+verify program — see run_all._run_child) and --publish is refused.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_ENGINES = ("openssl", "native-c", "pure-python")
+
+
+def _run(args, timeout):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.run(
+        [sys.executable, "-m", "benchmarks.run_all", *args],
+        cwd=REPO, capture_output=True, text=True, timeout=timeout, env=env,
+    )
+
+
+def test_run_all_smoke_covers_all_seven_configs():
+    proc = _run(["--smoke"], timeout=420)
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-800:]
+    recs = [
+        json.loads(line)
+        for line in proc.stdout.splitlines()
+        if line.startswith("{")
+    ]
+    by_config = {r.get("config"): r for r in recs}
+    assert sorted(by_config) == [str(i) for i in range(1, 8)], sorted(by_config)
+    for key, rec in sorted(by_config.items()):
+        assert not rec.get("error"), (key, rec)
+        assert "metric" in rec and "value" in rec, (key, rec)
+        # the provenance satellite: every record names its host engine
+        assert rec.get("host_crypto_engine") in _ENGINES, (key, rec)
+
+
+def test_smoke_refuses_publish():
+    proc = _run(["--smoke", "--publish"], timeout=60)
+    assert proc.returncode == 2
+    assert "meaningless" in proc.stderr

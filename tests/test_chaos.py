@@ -87,6 +87,82 @@ def test_traffic_survives_restart_plus_reconfig():
     run(main())
 
 
+def test_early_quorum_commit_bounded_by_quorum_not_straggler():
+    """PR-5 tentpole pin: one replica of the write set pinned at +200 ms
+    must not gate commits — the early-quorum write path waits for the
+    2f+1st consistent verified answer, not the slowest replica.  The
+    straggler still APPLIES every acked write (Write2 went to the full
+    set; only the client's wait is quorum-bound), so after its links heal
+    the acked values are present locally and readable cluster-wide, and
+    the left-behind responses show up in the straggler metrics instead of
+    vanishing."""
+
+    async def main():
+        sim = NetSim.mesh(seed=21, rtt_ms=13.0, jitter_ms=1.0)
+        async with VirtualCluster(5, rf=4, netsim=sim) as vc:
+            client = vc.client()
+            # establish sessions before degrading anything
+            await client.execute_write_transaction(
+                TransactionBuilder().write("straggle-warm", b"w").build()
+            )
+            # pick a key whose replica set includes the straggler
+            key = next(
+                f"straggle-{i}"
+                for i in range(64)
+                if "server-3" in vc.config.replica_set_for_key(f"straggle-{i}")
+            )
+            # degrade server-3 both ways: ~+200 ms RTT on top of the mesh
+            for src, dst in (("*", "server-3"), ("server-3", "*")):
+                sim.apply_event(
+                    LinkEvent(0.0, "set", src, dst,
+                              LinkSpec(delay_ms=106.5, jitter_ms=0.5))
+                )
+            lat_ms = []
+            for i in range(8):
+                t0 = asyncio.get_running_loop().time()
+                await client.execute_write_transaction(
+                    TransactionBuilder().write(key, b"v%d" % i).build()
+                )
+                lat_ms.append((asyncio.get_running_loop().time() - t0) * 1e3)
+            lat_ms.sort()
+            # quorum-bound: median far below the straggler's ~213 ms RTT
+            # (generous margin for this host's scheduler noise)
+            assert lat_ms[len(lat_ms) // 2] < 150.0, lat_ms
+            # stragglers were drained, not dropped: their late answers fed
+            # the per-replica metrics
+            counters = client.metrics.counters
+            assert counters.get("fanout.early-return", 0) > 0, counters
+            late = sum(
+                n for name, n in counters.items()
+                if name.startswith("fanout.late-response.")
+            )
+            timed_out = sum(
+                n for name, n in counters.items()
+                if name.startswith("fanout.straggler-timeout.")
+            )
+            assert late + timed_out > 0, counters
+            assert any(
+                name.startswith("fanout-straggler-ms.")
+                for name in client.metrics.histograms
+            ) or late == 0
+            # heal, let the in-flight Write2 + responses land
+            sim.apply_event(
+                LinkEvent(0.0, "set", "*", "*",
+                          LinkSpec(delay_ms=6.5, jitter_ms=0.5))
+            )
+            await asyncio.sleep(0.6)
+            # the acked write reached the straggler's own store
+            sv = vc.replica("server-3").store._get(key)
+            assert sv is not None and sv.exists and bytes(sv.value) == b"v7"
+            # ...and is readable cluster-wide after heal
+            res = await client.execute_read_transaction(
+                TransactionBuilder().read(key).build()
+            )
+            assert res.operations[0].value == b"v7"
+
+    run(asyncio.wait_for(main(), timeout=120))
+
+
 def test_acked_writes_survive_lossy_wan_partition_heal():
     """The restart+reconfig scenario above runs on a perfect loopback; real
     deployments lose the acked-write guarantee (or don't) under loss and
