@@ -274,10 +274,12 @@ def run_tree_ab(baseline_tree: str, pairs: int = 5) -> Dict:
 # ------------------------------------------------------- passthrough A/B
 
 
-async def _throughput_leg(netsim_disabled: bool) -> float:
+async def _throughput_leg(netsim_disabled: bool, keys: int = 10) -> float:
     """One small config-1-shaped leg; returns txn/s.  ``netsim_disabled``
     attaches a NetSim with enabled=False (policy objects never handed
-    out); False runs a tree with no netsim object at all."""
+    out); False runs a tree with no netsim object at all.  ``keys`` sizes
+    the leg: longer legs average intra-leg host noise, tightening the
+    per-pair ratio distribution the passthrough bound is built from."""
     from mochi_tpu.client.txn import TransactionBuilder
     from mochi_tpu.netsim import NetSim
     from mochi_tpu.testing.virtual_cluster import VirtualCluster
@@ -293,7 +295,7 @@ async def _throughput_leg(netsim_disabled: bool) -> float:
         async def worker(ci: int):
             nonlocal ops
             client = vc.client()
-            for k in range(10):
+            for k in range(keys):
                 key = f"pt-{ci}-{k}"
                 await client.execute_write_transaction(
                     TransactionBuilder().write(key, b"v").build()
@@ -315,35 +317,70 @@ async def _throughput_leg(netsim_disabled: bool) -> float:
     return ops / wall
 
 
-def run_passthrough_ab(pairs: int = 9) -> Dict:
+def _median_ci95(sorted_vals: List[float]):
+    """Nonparametric 95% CI for the median from order statistics: the
+    widest (lo, hi) ranks whose binomial(n, 1/2) tail mass is <= 2.5%
+    each side.  No distributional assumption — exactly what per-pair
+    ratios on a drifting shared host call for.  Returns None when even
+    the full (min, max) range cannot reach 95% coverage (n < 6: coverage
+    of the extremes is 1 - 2*0.5^n < 95%) — small-pair runs must not
+    publish an overconfident bound."""
+    n = len(sorted_vals)
+    if n < 6:
+        return None
+    tail = 0.0
+    k = 0
+    while k < n // 2:
+        tail += math.comb(n, k) * 0.5**n
+        if tail > 0.025:
+            break
+        k += 1
+    lo = max(0, k - 1)
+    return sorted_vals[lo], sorted_vals[n - 1 - lo]
+
+
+def run_passthrough_ab(pairs: int = 15, keys: int = 24) -> Dict:
     """Interleaved paired A/B (one disabled-netsim leg + one absent leg
     per pair, leg ORDER alternating pair to pair): interleaving absorbs
     host tenancy drift, alternation cancels any warmup/ordering bias.
-    The passthrough must be free — reports the median of per-pair ratios,
-    the statistic this host's ±10% run-to-run noise leaves trustworthy."""
+    The passthrough must be free — reports the median of per-pair ratios
+    plus an order-statistic 95% CI on that median, so the record carries
+    a real resolved BOUND ("overhead <= X% at 95%") instead of a prose
+    caveat when a window's median lands below zero overhead (r09's
+    sub-resolution annotation, re-measured this round at 15 pairs)."""
     ratios = []
     disabled = []
     absent = []
     for i in range(pairs):
         if i % 2 == 0:
-            d = asyncio.run(_throughput_leg(netsim_disabled=True))
-            a = asyncio.run(_throughput_leg(netsim_disabled=False))
+            d = asyncio.run(_throughput_leg(netsim_disabled=True, keys=keys))
+            a = asyncio.run(_throughput_leg(netsim_disabled=False, keys=keys))
         else:
-            a = asyncio.run(_throughput_leg(netsim_disabled=False))
-            d = asyncio.run(_throughput_leg(netsim_disabled=True))
+            a = asyncio.run(_throughput_leg(netsim_disabled=False, keys=keys))
+            d = asyncio.run(_throughput_leg(netsim_disabled=True, keys=keys))
         disabled.append(round(d, 1))
         absent.append(round(a, 1))
         ratios.append(d / a)
     median_ratio = statistics.median(ratios)
-    return {
+    ci = _median_ci95(sorted(ratios))
+    slower = sum(1 for r in ratios if r < 1.0)
+    rec = {
         "pairs": pairs,
         "disabled_txn_s": disabled,
         "absent_txn_s": absent,
         "per_pair_ratio": [round(r, 4) for r in ratios],
         "median_ratio_disabled_over_absent": round(median_ratio, 4),
         "median_overhead_pct": round((1.0 - median_ratio) * 100.0, 2),
+        "pairs_disabled_slower": slower,
         "acceptance_le_2pct": abs(1.0 - median_ratio) <= 0.02,
     }
+    if ci is not None:
+        # The bound: overhead <= (1 - ci_lo) x 100% at 95% confidence.
+        rec["median_ratio_ci95"] = [round(ci[0], 4), round(ci[1], 4)]
+        rec["overhead_pct_upper_bound_95"] = round((1.0 - ci[0]) * 100.0, 2)
+    else:
+        rec["ci_note"] = "pairs < 6: no 95% CI is publishable at this n"
+    return rec
 
 
 def run(
@@ -376,11 +413,14 @@ def run(
     if isinstance(ab.get("median_overhead_pct"), float) and ab["median_overhead_pct"] < 0:
         # A disabled-netsim leg measuring FASTER than no netsim at all is
         # mechanically impossible (it does strictly more work): the host's
-        # tenancy noise exceeded the bound's resolution in this window.
+        # tenancy noise exceeded the point estimate's resolution in this
+        # window.  The order-statistic CI above is the real artifact — the
+        # bound "overhead <= overhead_pct_upper_bound_95" holds regardless
+        # of which side of zero the median lands on.
         ab["note"] = (
-            "negative overhead = tenancy noise above the 2% resolution; "
-            "the r08-committed ≤2% bound stands (the `link is None` seam "
-            "is unchanged)"
+            "negative point-estimate overhead = tenancy noise; read "
+            "overhead_pct_upper_bound_95 for the resolved 95% bound "
+            "(the `link is None` seam is unchanged)"
         )
     engine = host_crypto_engine()
     rec = {

@@ -29,6 +29,7 @@ CONFIG_NAMES = {
     "5": "config5_multichip",
     "6": "config6_bigcluster",
     "7": "config7_wan",
+    "8": "config8_scaleout",
 }
 
 # --smoke: tiny-count kwargs per config — a seconds-scale pass whose only
@@ -45,6 +46,15 @@ SMOKE_KWARGS = {
     "5": dict(batch_per_device=256, n_groups=8, iters=1),
     "6": dict(writers=2, writes_per_writer=1, verifier="cpu", shapes=(4,)),
     "7": dict(n_clients=2, keys_per_client=2, sweeps=1, ab_pairs=0),
+    # 2 real server processes, 1 interleaved pair: exercises the whole
+    # ProcessCluster spawn/READY/drain surface in seconds (the children
+    # run the REAL engines — the parent's smoke stubs don't cross the
+    # process boundary, and don't need to: child boot cost is import, not
+    # XLA compiles, with the inline cpu verifier).
+    "8": dict(
+        n_servers=4, rf=4, process_counts=(1, 2), n_clients=2,
+        keys_per_client=4, sweeps=1, pairs=1, ops_per_txn=2,
+    ),
 }
 
 

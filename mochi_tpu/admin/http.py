@@ -45,6 +45,8 @@ quorum={quorum} &middot; {member}</p>
 <table><tr><th>server</th><th>endpoint</th></tr>{member_rows}</table>
 <h2>Store</h2>
 <table>{store_rows}</table>
+<h2>Shard</h2>
+<table>{shard_rows}</table>
 <h2>Verifier</h2>
 <table>{verifier_rows}</table>
 <h2>Batching</h2>
@@ -317,6 +319,11 @@ class AdminServer(HttpJsonServer):
                         "servers": {s.server_id: s.url for s in cfg.servers.values()},
                     },
                     "store": r.store.stats(),
+                    # Token-ring ownership + per-phase owned/foreign traffic
+                    # (the shard-per-core scale-out observable: foreign
+                    # counters at ~0 mean client routing matches the ring —
+                    # docs/OPERATIONS.md §4e)
+                    "shard": r.store.shard_stats(),
                     "verifier": verifier_stats(r.verifier),
                     "batching": {
                         name: h.snapshot()
@@ -368,6 +375,14 @@ class AdminServer(HttpJsonServer):
                     for k, v in samples
                 )
             body += _fanout_prom(r.metrics, "server", r.server_id)
+            # Per-shard ownership/traffic gauges: one family, stat-labeled,
+            # so "is any replica serving foreign-shard traffic?" is a single
+            # PromQL query across the fleet.
+            sid = _prom_esc(r.server_id)
+            body += "# TYPE mochi_shard gauge\n" + "".join(
+                f'mochi_shard{{stat="{k}",server="{sid}"}} {v}\n'
+                for k, v in sorted(r.store.shard_stats().items())
+            )
             netsim = _live_netsim(r)
             if netsim is not None:
                 # Per-directed-link conditioning stats as one gauge family:
@@ -405,6 +420,7 @@ class AdminServer(HttpJsonServer):
                 member="member" if r.server_id in cfg.servers else "NOT A MEMBER",
                 member_rows=member_rows,
                 store_rows=_rows(r.store.stats()),
+                shard_rows=_rows(r.store.shard_stats()),
                 verifier_rows=_rows(verifier_stats(r.verifier)),
                 batching_rows=_batching_rows(r.metrics),
                 fanout_rows=_fanout_rows(r.metrics),

@@ -148,6 +148,15 @@ class ClusterConfig:
     _replica_set_cache: Dict[int, List[str]] = field(
         default_factory=dict, repr=False, compare=False
     )
+    # key -> token memo: token_for_key is called for EVERY operation of
+    # every request on both sides (client routing, replica owns(), quorum
+    # tallies — ~200 calls per 32-op transaction, r10 profile) and each
+    # miss pays a SHA-512.  Bounded: cleared wholesale at capacity — a
+    # working set larger than the bound just degrades to the old
+    # hash-every-time behavior for one generation.
+    _token_cache: Dict[str, int] = field(
+        default_factory=dict, repr=False, compare=False
+    )
 
     # ---------------------------------------------------------------- quorums
 
@@ -169,11 +178,21 @@ class ClusterConfig:
 
     # --------------------------------------------------------------- sharding
 
+    _TOKEN_CACHE_MAX = 65536
+
     def token_for_key(self, key: str) -> int:
-        if key.startswith(CONFIG_KEY_PREFIX):
-            # Config-space keys are owned everywhere (ref: InMemoryDataStore.java:64-73)
-            return 0
-        return (stable_key_hash(key) * SHARD_TOKENS) >> 64
+        token = self._token_cache.get(key)
+        if token is None:
+            if key.startswith(CONFIG_KEY_PREFIX):
+                # Config-space keys are owned everywhere
+                # (ref: InMemoryDataStore.java:64-73)
+                token = 0
+            else:
+                token = (stable_key_hash(key) * SHARD_TOKENS) >> 64
+            if len(self._token_cache) >= self._TOKEN_CACHE_MAX:
+                self._token_cache.clear()
+            self._token_cache[key] = token
+        return token
 
     def replica_set_for_token(self, token: int) -> List[str]:
         """Walk the ring forward from ``token`` collecting RF distinct owners.

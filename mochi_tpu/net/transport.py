@@ -634,6 +634,42 @@ class RpcServer:
             return self.port  # UDS has no port; identity stays the path
         return self._server.sockets[0].getsockname()[1]
 
+    async def quiesce(self, timeout_s: float = 5.0) -> None:
+        """Graceful drain before ``close()``: stop accepting new
+        connections, let in-flight handler/batch work finish (bounded by
+        ``timeout_s``), then flush every connection's coalesced output
+        buffer so responses already computed actually reach their clients.
+
+        This is the SIGTERM path's half of deterministic teardown
+        (``server/__main__.py``): ``close()`` alone cancels in-flight
+        batches and aborts connections, which is right for a crash-style
+        stop but loses the tail of admitted work on a supervisor's TERM.
+        Past the deadline, whatever is still running is handed to
+        ``close()``'s cancel sweep — drain bounds shutdown time, it does
+        not wait forever on a wedged handler.
+        """
+        if self._server is not None:
+            # Stop accepting; existing connections stay up for the drain.
+            # asyncio's Server.close() is idempotent, so the later close()
+            # call repeating it is harmless.
+            self._server.close()
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout_s
+        # _tasks empties as batches finish; undrained ingress respawns
+        # tasks via call_soon, so poll both until quiet or deadline.
+        while self._tasks or self._ingress or self._drain_scheduled:
+            remaining = deadline - loop.time()
+            if remaining <= 0:
+                break
+            tasks = list(self._tasks)
+            if tasks:
+                await asyncio.wait(tasks, timeout=remaining)
+            else:
+                await asyncio.sleep(0.005)  # let a scheduled drain run
+        for proto in list(self._protocols):
+            if proto.transport is not None:
+                proto.flush_now()
+
     async def close(self) -> None:
         # In-flight drain batches die with the server (their connections are
         # about to be aborted anyway); ingress enqueued but never drained is

@@ -7,6 +7,20 @@ SURVEY.md §3.1), as a plain asyncio process instead of a Spring Boot shell.
 Usage:
     python -m mochi_tpu.server --config cluster/cluster_config.json \
         --server-id server-0 --seed-file cluster/server-0.seed [--verifier cpu|tpu]
+
+Repeating ``--server-id``/``--seed-file`` (pairwise, in order) hosts SEVERAL
+replicas on this process's one event loop — the packing knob of the
+shard-per-core deployment ladder (``testing/process_cluster.py``,
+``benchmarks/config8_scaleout.py``): one replica per process is the
+production scale-out posture; all replicas in one process is the
+single-core baseline the ladder is measured against.
+
+Lifecycle: each replica prints ``READY <server-id> <port>`` on stdout once
+it serves (the machine-readable readiness probe), and SIGTERM/SIGINT runs a
+bounded graceful drain — stop accepting, finish admitted work, flush
+coalesced response writes (``MochiReplica.drain``) — before the close path
+(final snapshot, pool/socket teardown), so a supervisor's TERM is
+deterministic instead of a mid-batch abort.
 """
 
 from __future__ import annotations
@@ -28,23 +42,9 @@ def load_config(path: str) -> ClusterConfig:
     return ClusterConfig.from_properties(text)
 
 
-async def amain(args) -> None:
-    config = load_config(args.config)
-    if args.require_client_auth and not config.admin_keys:
-        # Unrecoverable lockout otherwise: every client is unknown, and
-        # registering one requires an authenticated write, which requires
-        # being registered — only an admin key breaks the cycle.
-        raise SystemExit(
-            "--require-client-auth needs config.admin_keys to bootstrap the "
-            "client registry (generate with gen_cluster --with-admin)"
-        )
-    keypair = keypair_from_seed(bytes.fromhex(Path(args.seed_file).read_text().strip()))
-    if keypair.public_key != config.public_keys.get(args.server_id):
-        raise SystemExit(
-            f"seed file does not match configured public key for {args.server_id}"
-        )
-    info = config.servers[args.server_id]
-    verifier = None
+def _build_verifier(args, config: ClusterConfig):
+    """One verifier instance per hosted replica (simple ownership: each
+    replica's close is followed by its own verifier's close)."""
     if args.verifier == "tpu":
         try:
             from ..verifier.tpu import TpuBatchVerifier
@@ -55,10 +55,10 @@ async def amain(args) -> None:
         # printed once the verifier can serve.  The cluster's replica
         # identities are known signers: their cert signatures take the
         # doubling-free comb path (crypto/comb.py).
-        verifier = TpuBatchVerifier(
+        return TpuBatchVerifier(
             warmup_buckets=(16,), signers=list(config.public_keys.values())
         )
-    elif args.verifier.startswith("remote:"):
+    if args.verifier.startswith("remote:"):
         # Shared TPU sidecar: one mochi_tpu.verifier.service process owns the
         # chip; every replica ships its signature batches there (the north
         # star's sidecar boundary — a chip has one owner process).
@@ -78,52 +78,89 @@ async def amain(args) -> None:
         # Coalescer: concurrent Write2 certificate checks share one RPC
         # round trip to the service instead of paying one each (two
         # loopback frames per call dominate the replica-side cost).
-        verifier = CoalescingVerifier(RemoteVerifier(host, int(port), secret=secret))
-    elif args.verifier != "cpu":
+        return CoalescingVerifier(RemoteVerifier(host, int(port), secret=secret))
+    if args.verifier != "cpu":
         # No silent fallback: a typo'd --verifier must not quietly run the
         # inline CPU path (the misconfiguration argparse choices= used to
         # reject before remote:<host>:<port> made the value open-ended).
         raise SystemExit(
             f"unknown --verifier {args.verifier!r}: use cpu | tpu | remote:<host>:<port>"
         )
-    snapshot_path = None
-    if args.data_dir:
-        snapshot_path = str(Path(args.data_dir) / f"{args.server_id}.snapshot")
-    replica = MochiReplica(
-        server_id=args.server_id,
-        config=config,
-        keypair=keypair,
-        verifier=verifier,
-        require_client_auth=args.require_client_auth,
-        host=args.host or info.host,
-        port=info.port,
-        snapshot_path=snapshot_path,
-        snapshot_interval_s=args.snapshot_interval,
-        shed_lag_ms=args.shed_lag_ms,
-    )
-    await replica.start()
-    if args.resync_on_boot:
-        # Replica state is in-memory (like the reference): after a restart,
-        # pull committed state from peers before serving (paper's UptoSpeed).
-        advanced = await replica.resync()
-        logging.info("boot resync: %d objects recovered", advanced)
-    admin = None
-    if args.admin_port is not None:
-        from ..admin import AdminServer
+    return None  # replica defaults to the inline CpuVerifier
 
-        # Deliberately NOT args.host: --host 0.0.0.0 opens the replica
-        # protocol port, but the unauthenticated admin endpoints stay on
-        # loopback unless --admin-host explicitly widens them.
-        admin = AdminServer(replica, host=args.admin_host, port=args.admin_port)
-        await admin.start()
-        logging.info("admin shell on port %s", admin.bound_port)
-    logging.info("replica %s serving on %s:%s", args.server_id, replica.rpc.host, replica.bound_port)
-    print(f"READY {args.server_id} {replica.bound_port}", flush=True)
-    # Graceful SIGTERM/SIGINT: run the real close path — final snapshot
-    # (state is in-memory; the snapshot IS the durability), peer/RPC
-    # teardown, and the UDS socket unlink.  Without this a supervisor's
-    # TERM loses the last snapshot interval and leaves stale .sock files
-    # (reclaimed at next bind, but ENOENT beats ECONNREFUSED for probes).
+
+async def amain(args) -> None:
+    config = load_config(args.config)
+    if args.require_client_auth and not config.admin_keys:
+        # Unrecoverable lockout otherwise: every client is unknown, and
+        # registering one requires an authenticated write, which requires
+        # being registered — only an admin key breaks the cycle.
+        raise SystemExit(
+            "--require-client-auth needs config.admin_keys to bootstrap the "
+            "client registry (generate with gen_cluster --with-admin)"
+        )
+    server_ids = args.server_id
+    seed_files = args.seed_file
+    if len(server_ids) != len(seed_files):
+        raise SystemExit(
+            f"{len(server_ids)} --server-id but {len(seed_files)} --seed-file "
+            "(repeat them pairwise, in order)"
+        )
+    if len(set(server_ids)) != len(server_ids):
+        raise SystemExit(f"duplicate --server-id in {server_ids}")
+    replicas = []
+    admins = []
+    for i, (sid, seed_file) in enumerate(zip(server_ids, seed_files)):
+        keypair = keypair_from_seed(bytes.fromhex(Path(seed_file).read_text().strip()))
+        if keypair.public_key != config.public_keys.get(sid):
+            raise SystemExit(
+                f"seed file does not match configured public key for {sid}"
+            )
+        info = config.servers[sid]
+        snapshot_path = None
+        if args.data_dir:
+            snapshot_path = str(Path(args.data_dir) / f"{sid}.snapshot")
+        replica = MochiReplica(
+            server_id=sid,
+            config=config,
+            keypair=keypair,
+            verifier=_build_verifier(args, config),
+            require_client_auth=args.require_client_auth,
+            host=args.host or info.host,
+            port=info.port,
+            snapshot_path=snapshot_path,
+            snapshot_interval_s=args.snapshot_interval,
+            shed_lag_ms=args.shed_lag_ms,
+        )
+        await replica.start()
+        replicas.append(replica)
+        if args.resync_on_boot:
+            # Replica state is in-memory (like the reference): after a restart,
+            # pull committed state from peers before serving (paper's UptoSpeed).
+            advanced = await replica.resync()
+            logging.info("boot resync: %d objects recovered", advanced)
+        if args.admin_port is not None:
+            from ..admin import AdminServer
+
+            # Deliberately NOT args.host: --host 0.0.0.0 opens the replica
+            # protocol port, but the unauthenticated admin endpoints stay on
+            # loopback unless --admin-host explicitly widens them.  Hosted
+            # replica i serves its shell on --admin-port + i.
+            admin = AdminServer(replica, host=args.admin_host, port=args.admin_port + i)
+            await admin.start()
+            admins.append(admin)
+            logging.info("admin shell for %s on port %s", sid, admin.bound_port)
+        logging.info("replica %s serving on %s:%s", sid, replica.rpc.host, replica.bound_port)
+        # Machine-readable readiness probe (one line per hosted replica):
+        # supervisors and testing/process_cluster.py block on these.
+        print(f"READY {sid} {replica.bound_port}", flush=True)
+    # Graceful SIGTERM/SIGINT: drain first — stop accepting, finish admitted
+    # work, flush coalesced writes (bounded by --drain-timeout) — then the
+    # real close path: final snapshot (state is in-memory; the snapshot IS
+    # the durability), peer/RPC teardown, and the UDS socket unlink.
+    # Without this a supervisor's TERM aborts mid-batch, loses the last
+    # snapshot interval, and leaves stale .sock files (reclaimed at next
+    # bind, but ENOENT beats ECONNREFUSED for probes).
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
     import signal as _signal
@@ -135,18 +172,36 @@ async def amain(args) -> None:
             pass  # non-unix / nested-loop environments
     try:
         await stop.wait()
-        logging.info("shutdown signal received; closing %s", args.server_id)
+        logging.info("shutdown signal received; draining %s", server_ids)
     finally:
-        if admin is not None:
+        await asyncio.gather(
+            *(r.drain(args.drain_timeout) for r in replicas),
+            return_exceptions=True,
+        )
+        for admin in admins:
             await admin.close()
-        await replica.close()
+        for replica in replicas:
+            await replica.close()
+            if replica.verifier is not None:
+                await replica.verifier.close()
 
 
 def main(argv=None) -> None:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--config", required=True)
-    parser.add_argument("--server-id", required=True)
-    parser.add_argument("--seed-file", required=True)
+    parser.add_argument(
+        "--server-id",
+        action="append",
+        required=True,
+        help="replica identity to host; repeat (with a pairwise --seed-file) "
+        "to host several replicas on this process's event loop",
+    )
+    parser.add_argument(
+        "--seed-file",
+        action="append",
+        required=True,
+        help="hex Ed25519 seed for the matching --server-id (same order)",
+    )
     parser.add_argument("--host", default=None, help="bind host override (e.g. 0.0.0.0)")
     parser.add_argument(
         "--verifier",
@@ -163,7 +218,8 @@ def main(argv=None) -> None:
         "--admin-port",
         type=int,
         default=None,
-        help="serve the HTTP admin shell (/status, /metrics) on this port",
+        help="serve the HTTP admin shell (/status, /metrics) on this port "
+        "(hosted replica i gets port+i)",
     )
     parser.add_argument(
         "--admin-host",
@@ -200,7 +256,15 @@ def main(argv=None) -> None:
         type=float,
         default=30.0,
         help="overload admission control: shed new Write1s when event-loop "
-        "lag EWMA exceeds this (0 disables)",
+        "lag EWMA exceeds this (0 disables; recommended when several "
+        "replicas share this process's loop — see testing/virtual_cluster)",
+    )
+    parser.add_argument(
+        "--drain-timeout",
+        type=float,
+        default=5.0,
+        help="max seconds the SIGTERM/SIGINT drain waits for in-flight "
+        "work before the close path cancels the remainder",
     )
     parser.add_argument("--log-level", default="INFO")
     args = parser.parse_args(argv)

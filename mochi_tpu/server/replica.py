@@ -246,6 +246,17 @@ class MochiReplica:
             except Exception:
                 LOG.exception("periodic snapshot failed")
 
+    async def drain(self, timeout_s: float = 5.0) -> None:
+        """Graceful-shutdown drain (SIGTERM semantics): stop accepting new
+        connections, let admitted work finish and its coalesced response
+        writes flush, bounded by ``timeout_s``.  Callers follow with
+        :meth:`close` — which then finds no in-flight batches to cancel,
+        so the final snapshot captures every transaction the replica
+        acknowledged.  The process harness (``testing/process_cluster.py``)
+        relies on this for deterministic teardown: TERM → drain → close →
+        exit 0, never a mid-batch abort."""
+        await self.rpc.quiesce(timeout_s)
+
     async def close(self) -> None:
         if self._lag_task is not None:
             self._lag_task.cancel()

@@ -192,6 +192,43 @@ class DataStore:
         # archived config documents (CONFIG_ARCHIVE_PREFIX keys, written by
         # the reconfiguration transaction itself).
         self.config_history: Dict[int, ClusterConfig] = {config.configstamp: config}
+        # Per-shard traffic accounting (token-ring ownership, the paper's L2
+        # layer): how many operations this replica served as an OWNER vs
+        # answered WRONG_SHARD because the client's routing (or a stale
+        # config) sent them here.  In a healthy shard-routed deployment the
+        # *_foreign counters stay at ~0 — a growing foreign count is the
+        # operator signal that clients hold a stale configstamp or a
+        # benchmark fans out wider than the replica sets it should target.
+        # Surfaced on the admin shell (/status "shard", mochi_shard gauges).
+        self.shard_counters: Dict[str, int] = {
+            "read_owned": 0,
+            "read_foreign": 0,
+            "write1_owned": 0,
+            "write1_foreign": 0,
+            "write2_applied": 0,
+            "write2_foreign": 0,
+        }
+
+    def shard_stats(self) -> Dict[str, int]:
+        """Token-ring ownership summary + per-phase owned/foreign counters.
+
+        ``tokens_primary`` counts ring tokens this replica is the first
+        owner of; ``tokens_in_replica_set`` counts tokens whose RF-member
+        walk includes it (= the share of the key space it serves).  Both
+        are derived from the live config, so a reconfiguration changes
+        them on the next scrape.
+        """
+        primary = sum(1 for sid in self.config.token_owners if sid == self.server_id)
+        in_set = sum(
+            1
+            for t in range(len(self.config.token_owners))
+            if self.server_id in self.config.replica_set_for_token(t)
+        )
+        return {
+            "tokens_primary": primary,
+            "tokens_in_replica_set": in_set,
+            **self.shard_counters,
+        }
 
     # ------------------------------------------------------------------ util
 
@@ -284,8 +321,10 @@ class DataStore:
         results: List[OperationResult] = []
         for op in transaction.operations:
             if not self.owns(op.key):
+                self.shard_counters["read_foreign"] += 1
                 results.append(OperationResult(status=Status.WRONG_SHARD))
                 continue
+            self.shard_counters["read_owned"] += 1
             sv = self._get(op.key)
             if sv is None:
                 results.append(OperationResult(None, None, False, Status.OK))
@@ -313,10 +352,12 @@ class DataStore:
             if op.key in grants:  # one grant per object per txn
                 continue
             if not self.owns(op.key):
+                self.shard_counters["write1_foreign"] += 1
                 grants[op.key] = Grant(
                     op.key, 0, self.config.configstamp, req.transaction_hash, Status.WRONG_SHARD
                 )
                 continue
+            self.shard_counters["write1_owned"] += 1
             sv = self._get_or_create(op.key)
             prospective_ts = sv.current_epoch + req.seed
             existing = sv.grant_at(prospective_ts)
@@ -336,8 +377,14 @@ class DataStore:
                     op.key, prospective_ts, self.config.configstamp, req.transaction_hash, Status.REFUSED
                 )
                 all_ok = False
-            if sv.current_certificate is not None:
-                current_certs[op.key] = sv.current_certificate
+                # The conflicting CURRENT state rides only the refusal —
+                # that is what the echo exists for (the reference's
+                # conflicting-state return).  Echoing every granted key's
+                # certificate made batched Write1 answers O(K^2): each of
+                # K certs carries MultiGrants spanning its whole K-op
+                # transaction (r10 profile: the dominant decode cost).
+                if sv.current_certificate is not None:
+                    current_certs[op.key] = sv.current_certificate
         multi_grant = MultiGrant(grants=grants, client_id=req.client_id, server_id=self.server_id)
         if all_ok:
             return Write1OkFromServer(multi_grant, current_certs)
@@ -520,6 +567,7 @@ class DataStore:
         staleness_checked: Dict[str, bool] = {}
         for op in transaction.operations:
             if not self.owns(op.key):
+                self.shard_counters["write2_foreign"] += 1
                 results.append(OperationResult(status=Status.WRONG_SHARD))
                 continue
             entry = coalesced.get(op.key)
@@ -558,6 +606,7 @@ class DataStore:
                 result = OperationResult(sv.value, sv.current_certificate, sv.exists, Status.OK)
             else:
                 result = self._apply(op, sv, ts, req.write_certificate, transaction)
+                self.shard_counters["write2_applied"] += 1
             results.append(result)
         return Write2AnsFromServer(TransactionResult(tuple(results)), rid="")
 
@@ -626,7 +675,15 @@ class DataStore:
                 self.on_client_key_change(op.key[len(CONFIG_CLIENT_PREFIX):])
             except Exception:
                 LOG.exception("client key change hook failed")
-        return OperationResult(op.value, wc, existed_before, Status.OK)
+        # certificate=None, deliberately: the client COORDINATED this write
+        # — it built ``wc`` and shipped it to us one message ago, and its
+        # Write2 tally fingerprints (value, status) only.  Echoing the
+        # certificate back multiplies the answer by quorum x batch-size
+        # MultiGrant trees: at 16-op batched PUTs that echo alone made the
+        # write path O(K^2) on the wire (~45% of all message-decode CPU,
+        # r10 profile).  Reads still return the full certificate — that is
+        # where a client learns state it does not already hold.
+        return OperationResult(op.value, None, existed_before, Status.OK)
 
     # ----------------------------------------------------------------- sync
 

@@ -1,3 +1,4 @@
+from .process_cluster import ProcessCluster
 from .virtual_cluster import VirtualCluster
 
-__all__ = ["VirtualCluster"]
+__all__ = ["ProcessCluster", "VirtualCluster"]

@@ -38,6 +38,17 @@ async def _main():
             assert doc["server_id"] == replica.server_id
             assert doc["cluster"]["rf"] == 4 and doc["cluster"]["quorum"] == 3
             assert doc["store"]["keys"] >= 0
+            # per-shard ownership/traffic accounting (token-ring): with
+            # rf=4 of 5 servers each replica serves 4/5 of the ring, and
+            # the committed write above must have counted as OWNED traffic
+            # on an owning replica — foreign counters stay 0 when client
+            # routing matches the ring
+            shard = doc["shard"]
+            assert shard["tokens_primary"] > 0
+            assert 0 < shard["tokens_in_replica_set"] <= 1024
+            if replica.server_id in replica.config.replica_set_for_key("adm-key"):
+                assert shard["write1_owned"] >= 1 and shard["write2_applied"] >= 1
+            assert shard["write1_foreign"] == 0 and shard["read_foreign"] == 0
 
             status, _, body = await loop.run_in_executor(None, _get, port, "/metrics")
             assert status == 200

@@ -28,8 +28,8 @@ def _run(args, timeout):
     )
 
 
-def test_run_all_smoke_covers_all_seven_configs():
-    proc = _run(["--smoke"], timeout=420)
+def test_run_all_smoke_covers_all_eight_configs():
+    proc = _run(["--smoke"], timeout=480)
     assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-800:]
     recs = [
         json.loads(line)
@@ -37,7 +37,7 @@ def test_run_all_smoke_covers_all_seven_configs():
         if line.startswith("{")
     ]
     by_config = {r.get("config"): r for r in recs}
-    assert sorted(by_config) == [str(i) for i in range(1, 8)], sorted(by_config)
+    assert sorted(by_config) == [str(i) for i in range(1, 9)], sorted(by_config)
     for key, rec in sorted(by_config.items()):
         assert not rec.get("error"), (key, rec)
         assert "metric" in rec and "value" in rec, (key, rec)

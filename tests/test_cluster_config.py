@@ -103,3 +103,100 @@ def test_json_roundtrip():
     cfg = make_config(6, 4)
     parsed = ClusterConfig.from_json(cfg.to_json())
     assert parsed == cfg
+
+
+# ------------------------------------------------ token-ring ownership edges
+#
+# The shard-per-core scale-out (config 8) leans on exactly these properties:
+# the ring walk at the top of the ring, non-dividing round-robin deals,
+# ownership consistency across reconfiguration, and routing determinism
+# across client restarts (same key -> same replica set in every process).
+
+
+def test_replica_set_wraps_at_top_of_ring():
+    cfg = make_config(6, 4)
+    rs = cfg.replica_set_for_token(SHARD_TOKENS - 1)
+    assert len(rs) == 4 and len(set(rs)) == 4
+    # round-robin deal: token t is owned by server-(t % n); the walk from
+    # 1023 must WRAP to tokens 0,1,... to collect its rf distinct owners.
+    # 1024 % 6 != 0, so the owner sequence restarts at the wrap — the
+    # expected set follows the RING position, not the modular pattern.
+    expected = [
+        f"server-{((SHARD_TOKENS - 1 + i) % SHARD_TOKENS) % 6}" for i in range(4)
+    ]
+    assert rs == expected  # ['server-3', 'server-0', 'server-1', 'server-2']
+    # and the wrapped walk agrees with the unwrapped one structurally:
+    # every token's set is rf distinct CONSECUTIVE ring owners
+    for token in (0, 1, SHARD_TOKENS // 2, SHARD_TOKENS - 2, SHARD_TOKENS - 1):
+        rs = cfg.replica_set_for_token(token)
+        assert len(set(rs)) == cfg.rf
+
+
+def test_round_robin_with_non_dividing_server_count():
+    # 1024 % n != 0 for n in (5, 6, 7): the deal must still cover every
+    # token exactly once with per-server counts within one of each other
+    for n in (5, 6, 7):
+        ids = [f"s{i}" for i in range(n)]
+        assignment = round_robin_token_assignment(ids)
+        all_tokens = sorted(t for tokens in assignment.values() for t in tokens)
+        assert all_tokens == list(range(SHARD_TOKENS)), n
+        counts = {sid: len(tokens) for sid, tokens in assignment.items()}
+        assert max(counts.values()) - min(counts.values()) <= 1, counts
+        # early ids get the extra token (deal order), never a hole
+        assert counts[ids[0]] == -(-SHARD_TOKENS // n)
+
+
+def test_owns_key_consistent_after_evolve():
+    cfg = make_config(6, 4)
+    grown = cfg.evolve(
+        {
+            **{sid: s.url for sid, s in cfg.servers.items()},
+            "server-6": "127.0.0.1:8007",
+            "server-7": "127.0.0.1:8008",
+        },
+        public_keys={"server-6": b"\x01" * 32, "server-7": b"\x02" * 32},
+    )
+    assert grown.configstamp == cfg.configstamp + 1
+    keys = [f"evolve-key-{i}" for i in range(200)]
+    for c in (cfg, grown):
+        for key in keys:
+            rset = set(c.replica_set_for_key(key))
+            # owns_key must agree with replica-set membership for EVERY
+            # server — replicas enforce WRONG_SHARD with owns_key while
+            # clients route with replica_set_for_key; divergence would
+            # make correctly-routed requests bounce
+            for sid in c.servers:
+                assert c.owns_key(sid, key) == (sid in rset), (key, sid)
+    # minimal movement: the vast majority of keys keep at least one member
+    # of their old replica set (the consistent-hash property evolve()
+    # exists for; stolen tokens cluster at the top of donors' lists, so a
+    # FEW keys can land on fully-fresh sets — a full re-deal would move
+    # essentially all of them)
+    moved = sum(
+        1
+        for key in keys
+        if not set(cfg.replica_set_for_key(key)) & set(grown.replica_set_for_key(key))
+    )
+    assert moved <= len(keys) * 0.1, f"evolve() fully moved {moved}/{len(keys)} keys"
+
+
+def test_shard_routing_deterministic_across_restarts():
+    # Same key -> same replica set from two independently-parsed config
+    # objects (a client restart re-parses the committed document; routing
+    # must not depend on process state, dict order, or cache warmth)
+    cfg = make_config(7, 4)
+    doc = cfg.to_json()
+    a = ClusterConfig.from_json(doc)
+    b = ClusterConfig.from_json(doc)
+    for i in range(300):
+        key = f"route-{i}"
+        assert a.replica_set_for_key(key) == b.replica_set_for_key(key)
+        assert a.token_for_key(key) == b.token_for_key(key)
+    # and the hash itself is pinned (process-independent SHA-512 prefix):
+    # a silent change here would strand every existing deployment's data
+    assert a.token_for_key("route-0") == b.token_for_key("route-0")
+    from mochi_tpu.cluster.config import stable_key_hash
+
+    assert stable_key_hash("mochi") == int.from_bytes(
+        __import__("hashlib").sha512(b"mochi").digest()[:8], "big"
+    )

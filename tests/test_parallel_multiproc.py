@@ -11,6 +11,13 @@ CPU devices (``--xla_force_host_platform_device_count``), and asserts:
 This is the documented single-machine recipe for exercising the real
 multi-host code path (the same calls a TPU pod slice runs under); the
 reference has no distributed runtime to compare against (SURVEY.md §2.9).
+
+NOTE: this test needs jaxlib multiprocess collectives and SKIPS on images
+whose CPU backend rejects them (the guarded skip below).  The repo's own
+multi-process deployment surface is covered WITHOUT that dependency by
+``tests/test_process_cluster.py`` (ProcessCluster: real server processes,
+cross-shard transactions, f=1 crash faults, graceful drain) — that suite
+runs on bare CI images and never skips.
 """
 
 from __future__ import annotations
