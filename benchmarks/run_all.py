@@ -30,6 +30,9 @@ CONFIG_NAMES = {
     "6": "config6_bigcluster",
     "7": "config7_wan",
     "8": "config8_scaleout",
+    # config 9 is reserved for the open-loop front-end-scale benchmark
+    # (ROADMAP item "thousands of concurrent clients")
+    "10": "config10_byzantine",
 }
 
 # --smoke: tiny-count kwargs per config — a seconds-scale pass whose only
@@ -42,7 +45,7 @@ SMOKE_KWARGS = {
     "1": dict(n_clients=2, keys_per_client=2, sweeps=1, verifier="cpu"),
     "2": dict(batch_sizes=(256,), iters=1, big_batch=0),
     "3": dict(n=4, f=1, n_ops=64, batch=256),
-    "4": dict(n=4, f=1, rounds=1),
+    "4": dict(n=4, f=1, rounds=1, wan_rounds=1, wan_clients=1, wan_keys=2),
     "5": dict(batch_per_device=256, n_groups=8, iters=1),
     "6": dict(writers=2, writes_per_writer=1, verifier="cpu", shapes=(4,)),
     "7": dict(n_clients=2, keys_per_client=2, sweeps=1, ab_pairs=0),
@@ -54,6 +57,13 @@ SMOKE_KWARGS = {
     "8": dict(
         n_servers=4, rf=4, process_counts=(1, 2), n_clients=2,
         keys_per_client=4, sweeps=1, pairs=1, ops_per_txn=2,
+    ),
+    # one honest + one adversarial leg end-to-end (live ByzantineReplica,
+    # invariant checker, evidence aggregation): the whole config-10
+    # harness surface in seconds
+    "10": dict(
+        n_clients=1, keys_per_client=2, sweeps=1, attacks=("silent",),
+        timeout_s=1.0,
     ),
 }
 

@@ -53,6 +53,8 @@ quorum={quorum} &middot; {member}</p>
 <table>{batching_rows}</table>
 <h2>Fan-out</h2>
 <table>{fanout_rows}</table>
+<h2>Byzantine evidence</h2>
+<table>{byzantine_rows}</table>
 <p class="muted">{sessions} live client sessions &middot;
 admin-gated: {admin_gated} &middot; page auto-refreshes</p>
 <ul>
@@ -129,7 +131,13 @@ _FANOUT_COUNTER_STATS = (
 def _fanout_stats(metrics) -> dict:
     """``{"early_returns": n, "peers": {sid: {...}}}`` from a registry's
     ``fanout*`` entries; empty peers dict when the process never fanned
-    out (the surface then stays compact rather than vanishing)."""
+    out (the surface then stays compact rather than vanishing).
+
+    Per-peer SUSPICION rides the same rows (``suspect.<kind>.<sid>``
+    counters from the client's tally paths — MochiDBClient.SUSPECT_KINDS —
+    rendered as ``suspect_<kind>``): the initiator's fan-out table is
+    where an operator asks "which replica is misbehaving?", so straggler
+    evidence and tally evidence about one peer belong on one row."""
     peers: dict = {}
     for name, h in metrics.histograms.items():
         if name.startswith("fanout-straggler-ms."):
@@ -142,6 +150,13 @@ def _fanout_stats(metrics) -> dict:
             if name.startswith(prefix):
                 peers.setdefault(name[len(prefix):], {})[
                     stat.replace("-", "_")
+                ] = n
+    for name, n in metrics.counters.items():
+        if name.startswith("suspect."):
+            kind, sep, sid = name[len("suspect."):].partition(".")
+            if sep and sid:
+                peers.setdefault(sid, {})[
+                    "suspect_" + kind.replace("-", "_")
                 ] = n
     return {
         "early_returns": metrics.counters.get("fanout.early-return", 0),
@@ -203,10 +218,56 @@ def _fanout_rows(metrics) -> str:
                      "straggler_drain_cancelled"):
             if stat in stats:
                 parts.append(f"{stat}={stats[stat]}")
+        # the per-peer suspicion row: tally-path evidence next to the
+        # transport evidence (docs/OPERATIONS.md §4f)
+        for stat in sorted(s for s in stats if s.startswith("suspect_")):
+            parts.append(f"{stat}={stats[stat]}")
         rows.append(
             f"<tr><td>{_esc(peer)}</td><td>{_esc(' '.join(parts))}</td></tr>"
         )
     return "".join(rows)
+
+
+def _byzantine_rows(replica) -> str:
+    """The "/" page Byzantine-evidence table: proven equivocations and
+    bad-grant attribution per peer (replica.byzantine_stats)."""
+    bz = replica.byzantine_stats()
+    rows = []
+    for sid, n in sorted(bz["equivocations"].items()):
+        rows.append(f"<tr><td>{_esc(sid)}</td><td>equivocations={n}</td></tr>")
+    for sid, n in sorted(bz["bad_grants"].items()):
+        rows.append(f"<tr><td>{_esc(sid)}</td><td>bad_grants={n}</td></tr>")
+    if bz["resync_bad_certificates"]:
+        rows.append(
+            "<tr><td>(resync)</td><td>bad_certificates="
+            f"{bz['resync_bad_certificates']}</td></tr>"
+        )
+    if not rows:
+        return "<tr><td>(no equivocation or bad-grant evidence)</td><td></td></tr>"
+    return "".join(rows)
+
+
+def _byzantine_prom(replica) -> str:
+    """``mochi_byzantine{peer,stat}`` exposition ('' when no evidence):
+    the PromQL answer to "has any replica been caught misbehaving?"."""
+    bz = replica.byzantine_stats()
+    sid = _prom_esc(replica.server_id)
+    lines = []
+    for stat, per_peer in (("equivocations", bz["equivocations"]),
+                           ("bad_grants", bz["bad_grants"])):
+        for peer, n in sorted(per_peer.items()):
+            lines.append(
+                f'mochi_byzantine{{peer="{_prom_esc(peer)}",stat="{stat}",'
+                f'server="{sid}"}} {n}\n'
+            )
+    if bz["resync_bad_certificates"]:
+        lines.append(
+            f'mochi_byzantine{{peer="",stat="resync_bad_certificates",'
+            f'server="{sid}"}} {bz["resync_bad_certificates"]}\n'
+        )
+    if not lines:
+        return ""
+    return "# TYPE mochi_byzantine gauge\n" + "".join(lines)
 
 
 def _batching_rows(metrics) -> str:
@@ -334,6 +395,10 @@ class AdminServer(HttpJsonServer):
                     # registry (peers empty on a pure responder — the
                     # key stays so dashboards need no existence probe)
                     "fanout": _fanout_stats(r.metrics),
+                    # per-peer misbehavior evidence: proven equivocations
+                    # (conflicting validly-signed grants for one slot) and
+                    # bad-grant attribution (docs/OPERATIONS.md §4f)
+                    "byzantine": r.byzantine_stats(),
                     "config_history_stamps": sorted(r.store.config_history),
                     "member": r.server_id in cfg.servers,
                     "admin_gated": bool(cfg.admin_keys),
@@ -375,6 +440,7 @@ class AdminServer(HttpJsonServer):
                     for k, v in samples
                 )
             body += _fanout_prom(r.metrics, "server", r.server_id)
+            body += _byzantine_prom(r)
             # Per-shard ownership/traffic gauges: one family, stat-labeled,
             # so "is any replica serving foreign-shard traffic?" is a single
             # PromQL query across the fleet.
@@ -424,6 +490,7 @@ class AdminServer(HttpJsonServer):
                 verifier_rows=_rows(verifier_stats(r.verifier)),
                 batching_rows=_batching_rows(r.metrics),
                 fanout_rows=_fanout_rows(r.metrics),
+                byzantine_rows=_byzantine_rows(r),
                 sessions=len(getattr(r, "_sessions", {})),
                 admin_gated=bool(cfg.admin_keys),
             )
@@ -475,6 +542,9 @@ class ClientAdminServer(HttpJsonServer):
                     "early_quorum": bool(c.early_quorum),
                     "sessions": len(c._sessions),
                     "fanout": _fanout_stats(m),
+                    # per-peer tally-path suspicion breakdown (the fanout
+                    # peers table carries the same data as suspect_* rows)
+                    "suspicion": c.suspicion_stats(),
                     "timers": {
                         name: t.snapshot() for name, t in sorted(m.timers.items())
                     },

@@ -23,6 +23,7 @@ import logging
 import os
 import random
 import uuid
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -73,11 +74,43 @@ SEED_RANGE = 1000  # ref: MochiDBClient.java:262 — seed = rand.nextInt(1000)
 # trying that replica again (see MochiDBClient._session_refused).
 SESSION_REFUSAL_TTL_S = 30.0
 
+# How long a client remembers a handshake that FAILED (timeout, connect
+# error, silent replica) before retrying it.  Shorter than the refusal TTL
+# — failures are transient faults, refusals are policy — but without it a
+# SILENT replica gates every fan-out behind a full handshake timeout
+# serially before the fan-out even starts: the config-10 silent attack
+# measured write p50 at ~2x the request timeout from exactly this.
+SESSION_FAILURE_TTL_S = 10.0
+
 # Consecutive fully-shed Write1 rounds before the client stops retrying and
 # surfaces hard overload as a typed RequestRefused.  At moderate shed
 # probabilities a spurious give-up is <1% (draws are per-attempt), while
 # hard overload (p~0.9) still fails in ~1 s of backoff.
 MAX_ALL_SHED_ROUNDS = 5
+
+# Per-peer suspicion counters the client accrues on its tally paths
+# (``suspect.<kind>.<sid>``; surfaced per peer on the ClientAdminServer
+# fan-out table next to the transport's straggler evidence).  Advisory
+# only: suspicion re-orders the trimmed read fan-out away from suspects —
+# it never changes a quorum rule, so a smeared honest replica loses read
+# traffic priority, never correctness.
+SUSPECT_KINDS = (
+    "no-response",      # fan-out leg timed out / errored at full wait
+    "bad-grant",        # grant failed signature/hash/configstamp validation
+    "grant-conflict",   # grant dropped from the timestamp-consistent subset
+    "tally-outvoted",   # answer disagreed with the 2f+1 winning fingerprint
+)
+
+# A peer becomes a read-routing suspect past this score: a couple of
+# outlier marks (an honest laggard mid-resync) must not exile a replica.
+SUSPICION_THRESHOLD = 2
+
+# Routing decisions look only at suspicion accrued within this window, so
+# a replica that recovers (restart blip, transient partition) re-enters
+# the trimmed-read rotation once its marks age out — the cumulative
+# counters stay monotonic for observability, but routing must not hold a
+# lifetime grudge.
+SUSPICION_WINDOW_S = 60.0
 
 
 @dataclass
@@ -109,6 +142,20 @@ class MochiDBClient:
     # before (kill switch: MOCHI_EARLY_QUORUM=0).
     early_quorum: bool = field(
         default_factory=lambda: os.environ.get("MOCHI_EARLY_QUORUM", "1") != "0"
+    )
+    # Grant-content validation on the Write1 tally path (Byzantine round):
+    # each arriving MultiGrant's Ed25519 signature is checked against the
+    # issuer's configured key, and its OK grants must carry THIS
+    # transaction's hash, BEFORE the grant can vote in the certificate
+    # subset.  Without this, one in-set replica
+    # returning a garbage-signed (or wrong-hash) grant inside a validly
+    # authenticated envelope poisons the assembled certificate and every
+    # replica rejects the Write2 — a measured liveness hole under the
+    # forge-cert attack (benchmarks/config10_byzantine.py).  Costs one
+    # host verify per grant (~0.2 ms native-C), overlapped with the
+    # fan-out's network wait.  Kill switch: MOCHI_VERIFY_GRANT_SIGS=0.
+    verify_grant_sigs: bool = field(
+        default_factory=lambda: os.environ.get("MOCHI_VERIFY_GRANT_SIGS", "1") != "0"
     )
     # First-attempt Write1 fan-out trimmed to a quorum (2f+1) instead of the
     # full replica set; retries widen to the full set.  Off by default: it
@@ -142,6 +189,11 @@ class MochiDBClient:
         # cases.  Also cleared outright on config refresh.
         self._session_refused: Dict[str, float] = {}
         self._read_rotor = 0
+        # sid -> timestamped suspicion events (the decaying routing score;
+        # the monotonic suspect.* counters are the observability record)
+        self._suspicion_events: Dict[str, deque] = {}
+        # sid -> last straggler-timeout counter value folded into events
+        self._straggler_seen: Dict[str, int] = {}
 
     # ------------------------------------------------------------ plumbing
 
@@ -153,6 +205,46 @@ class MochiDBClient:
                 seen[info.server_id] = info
         return sorted(seen.items())
 
+    def _suspect(self, sid: str, kind: str) -> None:
+        """Accrue one unit of per-peer suspicion (``SUSPECT_KINDS``):
+        a monotonic counter for the admin surfaces plus a timestamped
+        event for the decaying routing score."""
+        self.metrics.mark(f"suspect.{kind}.{sid}")
+        self._suspicion_events.setdefault(sid, deque(maxlen=4096)).append(
+            time.monotonic()
+        )
+
+    def _suspicion_score(self, sid: str) -> int:
+        """Misbehavior evidence against ``sid`` within the last
+        ``SUSPICION_WINDOW_S``: tally-path suspicion marks plus the
+        transport's straggler-timeout growth (the silent-replica signal,
+        folded in by counter delta since the counters themselves carry no
+        timestamps).  Windowed so a recovered replica re-enters the read
+        rotation instead of being exiled for the client's lifetime."""
+        now = time.monotonic()
+        events = self._suspicion_events.setdefault(sid, deque(maxlen=4096))
+        stragglers = self.metrics.counters.get(
+            f"fanout.straggler-timeout.{sid}", 0
+        )
+        seen = self._straggler_seen.get(sid, 0)
+        if stragglers > seen:
+            events.extend([now] * (stragglers - seen))
+            self._straggler_seen[sid] = stragglers
+        cutoff = now - SUSPICION_WINDOW_S
+        while events and events[0] < cutoff:
+            events.popleft()
+        return len(events)
+
+    def suspicion_stats(self) -> Dict[str, Dict[str, int]]:
+        """Per-peer suspicion breakdown (ClientAdminServer surface)."""
+        out: Dict[str, Dict[str, int]] = {}
+        for kind in SUSPECT_KINDS:
+            prefix = f"suspect.{kind}."
+            for name, n in self.metrics.counters.items():
+                if name.startswith(prefix):
+                    out.setdefault(name[len(prefix):], {})[kind] = n
+        return out
+
     def _quorum_targets(self, transaction: Transaction) -> List[Tuple[str, ServerInfo]]:
         """A minimal read fan-out: greedily cover every key's replica set
         with exactly ``quorum`` members (rotating the start point to spread
@@ -163,6 +255,14 @@ class MochiDBClient:
         lower (f+1, ``mochiDB.tex:142``).  A trimmed read can fail
         spuriously (a chosen replica lagging a just-committed write), so
         :meth:`_read_once` falls back to the full union before giving up.
+
+        Suspicion-aware: peers whose suspicion score exceeds
+        ``SUSPICION_THRESHOLD`` (straggler timeouts, outvoted answers,
+        bad grants) are chosen only when the quorum cannot be covered
+        without them — a silent or lying replica stops costing every
+        trimmed read a timeout + full-union retry after its first few
+        offenses.  Purely a liveness routing hint: the tally rules are
+        unchanged, and the full-union fallback still reaches everyone.
         """
         q = self.config.quorum
         chosen: Dict[str, ServerInfo] = {}
@@ -174,7 +274,16 @@ class MochiDBClient:
                 continue
             n = len(rset)
             start = self._read_rotor % n
-            for off in range(n):
+            order = sorted(
+                range(n),
+                key=lambda off: (
+                    self._suspicion_score(
+                        rset[(start + off) % n].server_id
+                    ) > SUSPICION_THRESHOLD,
+                    off,
+                ),
+            )
+            for off in order:
                 if have >= q:
                     break
                 info = rset[(start + off) % n]
@@ -287,6 +396,13 @@ class MochiDBClient:
                 raise
             except Exception as exc:
                 LOG.debug("session handshake with %s failed: %s", sid, exc)
+                # Remember the failure (short TTL): an unresponsive replica
+                # must not re-gate every subsequent fan-out behind a full
+                # handshake timeout — signed envelopes work meanwhile.
+                self.metrics.mark(f"client.handshake-failure.{sid}")
+                self._session_refused[sid] = (
+                    time.monotonic() + SESSION_FAILURE_TTL_S
+                )
                 return  # fall back to signed envelopes
             ack = res.payload
             if isinstance(ack, RequestFailedFromServer) and self._server_signed(
@@ -392,6 +508,11 @@ class MochiDBClient:
         for sid, res in results.items():
             if isinstance(res, Exception):
                 LOG.debug("no response from %s: %s", sid, res)
+                # full-wait legs that died/timed out; early-quorum
+                # stragglers accrue fanout.straggler-timeout.<sid> from
+                # the background drain instead — both feed the same
+                # per-peer suspicion score.
+                self._suspect(sid, "no-response")
                 continue
             if sid not in auth_ok and not self._authentic(sid, res):
                 LOG.warning("dropping unauthenticated response claiming to be %s", sid)
@@ -538,6 +659,7 @@ class MochiDBClient:
             }
             n_ops = len(transaction.operations)
             final: List = []
+            outvoted: set = set()
             for i in range(n_ops):
                 # Coalesce per-op results, ignoring WRONG_SHARD fillers
                 # (ref: MochiDBClient.java:148-175).  Only servers in the
@@ -546,6 +668,7 @@ class MochiDBClient:
                 # the multi-key fan-out union — must not tip the tally.
                 rset = set(self.config.replica_set_for_key(transaction.operations[i].key))
                 tallies: Dict[bytes, Tuple[int, object]] = {}
+                votes: Dict[str, tuple] = {}
                 for sid, p in reads.items():
                     if sid not in rset or i >= len(p.result.operations):
                         continue
@@ -553,6 +676,7 @@ class MochiDBClient:
                     if op_res.status == Status.WRONG_SHARD:
                         continue
                     fp = (bytes(op_res.value or b""), op_res.existed)
+                    votes[sid] = fp
                     count, _ = tallies.get(fp, (0, None))
                     tallies[fp] = (count + 1, op_res)
                 best = max(tallies.values(), key=lambda t: t[0], default=(0, None))
@@ -563,7 +687,15 @@ class MochiDBClient:
                         f"{self.config.quorum} ({responders} responders)",
                         responders=responders,
                     )
+                # With a quorum established, dissenting in-set answers are
+                # evidence (stale or lying replica) — at most once per txn.
+                winning_fp = next(fp for fp, t in tallies.items() if t is best)
+                outvoted.update(
+                    sid for sid, fp in votes.items() if fp != winning_fp
+                )
                 final.append(best[1])
+            for sid in outvoted:
+                self._suspect(sid, "tally-outvoted")
             return TransactionResult(tuple(final))
 
     # -------------------------------------------------------- reconfiguration
@@ -641,6 +773,46 @@ class MochiDBClient:
         self.config = new_config
 
     # --------------------------------------------------------------- writes
+
+    def _grant_ok(self, mg: MultiGrant, txn_hash: bytes) -> bool:
+        """Content validation for one arriving MultiGrant before it may
+        vote in certificate assembly: the issuer's Ed25519 signature over
+        the grant (envelope auth says who SENT it, not that the grant
+        inside verifies — replicas will check each grant independently, so
+        the client must too or a Byzantine in-set grant poisons the whole
+        certificate), plus per-grant content sanity — OK grants must carry
+        THIS transaction's hash.  Verdict is cached on the (frozen) grant
+        object: the early-quorum predicate and the authoritative
+        post-filter see the same instances."""
+        cached = mg.__dict__.get("_grant_ok")
+        if cached is not None:
+            return cached
+        ok = True
+        key = self.config.public_keys.get(mg.server_id)
+        # Crypto gated by the kill switch / unsigned-cluster posture; the
+        # FREE content check below always runs — disabling it would
+        # re-open the wrong-hash certificate-poisoning liveness hole the
+        # kill switch has no reason to buy back.
+        if key is not None and self.verify_grant_sigs and self.authenticate_servers:
+            if mg.signature is None or not cpu_verify(
+                key, mg.signing_bytes(), mg.signature
+            ):
+                ok = False
+        if ok:
+            # Content: OK grants must commit to THIS transaction's hash.
+            # Deliberately NOT a configstamp equality check — a stale
+            # client mid-reconfiguration legitimately receives grants
+            # stamped newer than its own config (the refresh path adopts
+            # it); configstamp games are caught by the replicas' own
+            # mixed-stamp certificate rejection.
+            for g in mg.grants.values():
+                if g.status == Status.OK and g.transaction_hash != txn_hash:
+                    ok = False
+                    break
+        if not ok:
+            self._suspect(mg.server_id, "bad-grant")
+        mg.__dict__["_grant_ok"] = ok  # frozen dataclass: cache via __dict__
+        return ok
 
     @staticmethod
     def _write1_transaction(transaction: Transaction) -> Transaction:
@@ -783,6 +955,7 @@ class MochiDBClient:
                     return (
                         isinstance(payload, Write1OkFromServer)
                         and payload.multi_grant.server_id == sid
+                        and self._grant_ok(payload.multi_grant, txn_hash)
                         and assembler.add(payload.multi_grant)
                     )
 
@@ -799,7 +972,11 @@ class MochiDBClient:
                     )
                 oks: List[MultiGrant] = []
                 for sid, p in responses.items():
-                    if isinstance(p, Write1OkFromServer) and p.multi_grant.server_id == sid:
+                    if (
+                        isinstance(p, Write1OkFromServer)
+                        and p.multi_grant.server_id == sid
+                        and self._grant_ok(p.multi_grant, txn_hash)
+                    ):
                         oks.append(p.multi_grant)
                 # Proceed as soon as a timestamp-consistent 2f+1 subset
                 # exists; refusals/outliers from up to f servers (contention,
@@ -808,6 +985,15 @@ class MochiDBClient:
                 # the assembler fired (authoritative; the assembler is a
                 # liveness signal — see client/txn.py).
                 chosen = self._quorum_grant_subset(transaction, oks)
+                if chosen is not None:
+                    # Suspicion accounting: a validated grant that still
+                    # fell out of the timestamp-consistent subset voted a
+                    # conflicting timestamp (Byzantine skew, or an honest
+                    # laggard pre-resync — the threshold absorbs those).
+                    chosen_ids = {mg.server_id for mg in chosen}
+                    for mg in oks:
+                        if mg.server_id not in chosen_ids:
+                            self._suspect(mg.server_id, "grant-conflict")
                 if chosen is not None and not self._is_admin_txn(transaction):
                     # Admin (config/archive) certificates keep ALL grants: a
                     # fresh member bootstrapping years later must still find
@@ -868,14 +1054,20 @@ class MochiDBClient:
                 certificate = WriteCertificate({mg.server_id: mg for mg in chosen})
                 try:
                     return await self._write2(transaction, certificate)
-                except InconsistentWrite:
+                except InconsistentWrite as exc:
                     # A reconfiguration may have landed between our phases
                     # (replicas reject cross-config certificates).  Adopt
-                    # the newer config if there is one and retry; otherwise
-                    # the failure is real.
-                    if not await self.refresh_config():
+                    # the newer config if there is one and retry; otherwise:
+                    # BAD_CERTIFICATE answers mean THIS certificate was the
+                    # problem (a poisoned grant that slipped validation, or
+                    # a replay race) — fresh grants can fix that, so burn a
+                    # refusal-retry instead of surfacing a dead end.  Any
+                    # other split is real and raises.
+                    if not await self.refresh_config() and not exc.bad_certificate:
                         raise
                     refusals += 1
+                    if refusals > self.refusal_retries:
+                        raise
                     continue
             raise RequestRefused(f"write did not converge in {self.write_attempts} attempts")
 
@@ -966,11 +1158,13 @@ class MochiDBClient:
     ) -> TransactionResult:
         n_ops = len(transaction.operations)
         final: List = []
+        outvoted: set = set()
         for i in range(n_ops):
             # Per-op votes restricted to the key's replica set (same
             # out-of-set exclusion as the read path).
             rset = set(self.config.replica_set_for_key(transaction.operations[i].key))
             tallies: Dict[Tuple, Tuple[int, object]] = {}
+            votes: Dict[str, Tuple] = {}
             for sid, p in responses.items():
                 if sid not in rset or not isinstance(p, Write2AnsFromServer):
                     continue
@@ -980,13 +1174,25 @@ class MochiDBClient:
                 if op_res.status == Status.WRONG_SHARD:
                     continue
                 fp = (bytes(op_res.value or b""), op_res.status)
+                votes[sid] = fp
                 count, _ = tallies.get(fp, (0, None))
                 tallies[fp] = (count + 1, op_res)
             best = max(tallies.values(), key=lambda t: t[0], default=(0, None))
             if best[0] < self.config.quorum:
-                # ref: per-op 2f+1 tally (MochiDBClient.java:355-382)
+                # ref: per-op 2f+1 tally (MochiDBClient.java:355-382).
+                # Flag certificate rejections: those are retryable with
+                # fresh grants (see execute_write_transaction).
                 raise InconsistentWrite(
-                    f"op {i}: best agreement {best[0]} < quorum {self.config.quorum}"
+                    f"op {i}: best agreement {best[0]} < quorum {self.config.quorum}",
+                    bad_certificate=any(
+                        isinstance(p, RequestFailedFromServer)
+                        and p.fail_type == FailType.BAD_CERTIFICATE
+                        for p in responses.values()
+                    ),
                 )
+            winning_fp = next(fp for fp, t in tallies.items() if t is best)
+            outvoted.update(sid for sid, fp in votes.items() if fp != winning_fp)
             final.append(best[1])
+        for sid in outvoted:
+            self._suspect(sid, "tally-outvoted")
         return TransactionResult(tuple(final))

@@ -20,7 +20,17 @@ class InconsistentRead(MochiClientError):
 
 
 class InconsistentWrite(MochiClientError):
-    """No 2f+1 agreeing Write2 acks (ref: ``InconsistentWriteException``)."""
+    """No 2f+1 agreeing Write2 acks (ref: ``InconsistentWriteException``).
+
+    ``bad_certificate``: replicas rejected the certificate itself
+    (BAD_CERTIFICATE answers in the tally) — retryable with fresh grants,
+    e.g. a Byzantine in-set grant poisoned this attempt's certificate; the
+    write loop burns a refusal-retry instead of surfacing the failure.
+    """
+
+    def __init__(self, msg: str, bad_certificate: bool = False):
+        super().__init__(msg)
+        self.bad_certificate = bad_certificate
 
 
 class RequestFailed(MochiClientError):

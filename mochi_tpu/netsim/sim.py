@@ -416,6 +416,16 @@ class NetSim:
         return events
 
     @staticmethod
+    def heal(node: str) -> List[LinkEvent]:
+        """The ``up`` twin of :meth:`partition`: events clearing ``node``'s
+        uplink+downlink downs, for drivers applying events directly
+        (``apply_event``) instead of scheduling heal_at_s up front."""
+        return [
+            LinkEvent(0.0, "up", node, "*"),
+            LinkEvent(0.0, "up", "*", node),
+        ]
+
+    @staticmethod
     def degrade_uplink(
         node: str, at_s: float, spec: LinkSpec, until_s: Optional[float] = None
     ) -> List[LinkEvent]:

@@ -108,6 +108,14 @@ async def amain(args) -> None:
         )
     if len(set(server_ids)) != len(server_ids):
         raise SystemExit(f"duplicate --server-id in {server_ids}")
+    byzantine = {}
+    for spec in args.byzantine or ():
+        sid, sep, strategy = spec.partition("=")
+        if not sep or not strategy:
+            raise SystemExit(f"--byzantine wants <server-id>=<strategy>, got {spec!r}")
+        if sid not in server_ids:
+            raise SystemExit(f"--byzantine {spec!r}: {sid} is not hosted here")
+        byzantine[sid] = strategy
     replicas = []
     admins = []
     for i, (sid, seed_file) in enumerate(zip(server_ids, seed_files)):
@@ -120,7 +128,20 @@ async def amain(args) -> None:
         snapshot_path = None
         if args.data_dir:
             snapshot_path = str(Path(args.data_dir) / f"{sid}.snapshot")
-        replica = MochiReplica(
+        replica_cls = MochiReplica
+        replica_kwargs = {}
+        if sid in byzantine:
+            # Fault-injection posture (testing/process_cluster drives this
+            # for cross-process adversarial scenarios); make_strategy
+            # rejects unknown names before the replica binds a port.
+            from ..testing.byzantine import ByzantineReplica, make_strategy
+
+            make_strategy(byzantine[sid])  # validate early, fail the boot
+            replica_cls = ByzantineReplica
+            replica_kwargs = dict(
+                strategy=byzantine[sid], strategy_seed=sum(sid.encode())
+            )
+        replica = replica_cls(
             server_id=sid,
             config=config,
             keypair=keypair,
@@ -131,6 +152,7 @@ async def amain(args) -> None:
             snapshot_path=snapshot_path,
             snapshot_interval_s=args.snapshot_interval,
             shed_lag_ms=args.shed_lag_ms,
+            **replica_kwargs,
         )
         await replica.start()
         replicas.append(replica)
@@ -258,6 +280,16 @@ def main(argv=None) -> None:
         help="overload admission control: shed new Write1s when event-loop "
         "lag EWMA exceeds this (0 disables; recommended when several "
         "replicas share this process's loop — see testing/virtual_cluster)",
+    )
+    parser.add_argument(
+        "--byzantine",
+        action="append",
+        default=None,
+        metavar="SID=STRATEGY",
+        help="FAULT INJECTION (testing only): host the named replica as a "
+        "ByzantineReplica running the given attack strategy (equivocate | "
+        "forge-cert | stale-replay | silent | storm) — see "
+        "mochi_tpu/testing/byzantine.py and docs/OPERATIONS.md §4f",
     )
     parser.add_argument(
         "--drain-timeout",

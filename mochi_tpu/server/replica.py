@@ -46,6 +46,7 @@ from ..protocol import (
     RequestFailedFromServer,
     SessionAckFromServer,
     SessionInitToServer,
+    Status,
     SyncAckFromServer,
     SyncEntriesFromServer,
     SyncRequestToServer,
@@ -60,6 +61,21 @@ from ..verifier.spi import CpuVerifier, SignatureVerifier, VerifyItem
 from .store import BadRequest, DataStore
 
 LOG = logging.getLogger(__name__)
+
+# Equivocation ledger bound: how many (object, ts, configstamp, signer) ->
+# txn-hash observations a replica remembers from VALIDLY SIGNED grants it
+# verified.  A second validly-signed grant from the same signer for the
+# same slot with a DIFFERENT hash is cryptographic proof of equivocation —
+# the one Byzantine behavior signatures alone cannot prevent, only convict.
+# FIFO-bounded: old slots age out (their epochs are long past the GC
+# horizon anyway); an adversary churning the ledger only evicts evidence
+# about ancient timestamps.
+GRANT_LEDGER_MAX = 16384
+# Distinct conflicting hashes remembered per slot: one conviction per
+# distinct lie is plenty of evidence, and an adversary spraying many
+# hashes at ONE slot must not grow a single entry (or its O(len) scan on
+# the Write2 hot path) without bound.
+GRANT_LEDGER_SLOT_MAX = 8
 
 # Per-batch budget of certificate VerifyItems pooled OPTIMISTICALLY (i.e.
 # for Write2 envelopes whose own auth verdict is still pending in the same
@@ -133,6 +149,13 @@ class MochiReplica:
         # deterministic re-sign (~57 us saved per write2).  Bounded FIFO; a
         # miss (evicted, or issued before a restart) falls back to re-sign.
         self._own_grant_sigs: Dict[bytes, bytes] = {}
+        # Byzantine-evidence ledger (docs/OPERATIONS.md §4f): the distinct
+        # transaction hashes seen per (object, ts, configstamp, signer)
+        # from validly-signed grants; each NEW conflicting hash convicts
+        # the signer of one equivocation (counted per peer, surfaced on
+        # /status "byzantine" and the mochi_byzantine prom family).
+        self._grant_ledger: Dict[tuple, tuple] = {}
+        self._equivocations: Dict[str, int] = {}
         # Admission control (overload shedding): a heartbeat task measures
         # event-loop scheduling lag; when its EWMA exceeds ``shed_lag_ms``
         # the replica sheds NEW transactions (Write1 -> OVERLOADED) while
@@ -1139,9 +1162,74 @@ class MochiReplica:
         kept = {sid: wc.grants[sid] for sid, ok in zip(server_ids, valid) if ok}
         if len(kept) != len(server_ids):
             self.metrics.mark("replica.dropped-grants", len(server_ids) - len(kept))
+            for sid, ok in zip(server_ids, valid):
+                # Per-signer attribution: a grant claiming sid that failed
+                # its signature is evidence about the CARRIER of the
+                # certificate, not proof against sid — but a replica whose
+                # id keeps appearing on bad grants is the operator's first
+                # suspect row.  Only MEMBER ids get a counter: fabricated
+                # signer strings must not mint unbounded metric names
+                # (counter cardinality stays bounded by the membership).
+                if not ok and sid in self.config.public_keys:
+                    self.metrics.mark(f"replica.bad-grant.{sid}")
         if not kept:
             return None
+        self._note_grant_evidence(kept.values())
         return WriteCertificate(kept)
+
+    def _note_grant_evidence(self, multigrants) -> None:
+        """Equivocation detection over VALIDLY SIGNED grants only (a forged
+        grant must never frame an honest signer): remember the transaction
+        hash each signer committed to per (object, ts, configstamp); a
+        conflicting re-observation is cryptographic proof the signer issued
+        two grants for the same slot — count and surface it."""
+        ledger = self._grant_ledger
+        for mg in multigrants:
+            for g in mg.grants.values():
+                if g.status != Status.OK:
+                    continue
+                slot = (g.object_id, g.timestamp, g.configstamp, mg.server_id)
+                seen = ledger.get(slot)
+                if seen is None:
+                    if len(ledger) >= GRANT_LEDGER_MAX:
+                        ledger.pop(next(iter(ledger)))
+                    ledger[slot] = (g.transaction_hash,)
+                elif (
+                    g.transaction_hash not in seen
+                    and len(seen) < GRANT_LEDGER_SLOT_MAX
+                ):
+                    # Each DISTINCT conflicting hash convicts once; a
+                    # retried certificate re-presenting the same lie must
+                    # not inflate the published equivocation count, and a
+                    # hash-spray against one slot stops counting (and
+                    # growing) at the slot cap.
+                    ledger[slot] = seen + (g.transaction_hash,)
+                    self._equivocations[mg.server_id] = (
+                        self._equivocations.get(mg.server_id, 0) + 1
+                    )
+                    self.metrics.mark(f"replica.equivocation.{mg.server_id}")
+                    LOG.warning(
+                        "EQUIVOCATION by %s: object %r ts=%d granted to two "
+                        "transactions", mg.server_id, g.object_id, g.timestamp,
+                    )
+
+    def byzantine_stats(self) -> Dict[str, object]:
+        """Per-peer misbehavior evidence for the admin surfaces (/status
+        "byzantine", ``mochi_byzantine`` prom family): proven equivocations
+        plus bad-grant and resync-rejection attribution counters."""
+        prefix = "replica.bad-grant."
+        bad_grants = {
+            name[len(prefix):]: n
+            for name, n in self.metrics.counters.items()
+            if name.startswith(prefix)
+        }
+        return {
+            "equivocations": dict(self._equivocations),
+            "bad_grants": bad_grants,
+            "resync_bad_certificates": self.metrics.counters.get(
+                "replica.resync-bad-certificate", 0
+            ),
+        }
 
     async def _check_certificate(self, wc: WriteCertificate) -> Optional[WriteCertificate]:
         """Verify every MultiGrant signature in a write certificate; drop

@@ -1,4 +1,14 @@
+from .byzantine import STRATEGIES, AttackStrategy, ByzantineReplica, make_strategy
+from .invariants import InvariantChecker
 from .process_cluster import ProcessCluster
 from .virtual_cluster import VirtualCluster
 
-__all__ = ["ProcessCluster", "VirtualCluster"]
+__all__ = [
+    "AttackStrategy",
+    "ByzantineReplica",
+    "InvariantChecker",
+    "ProcessCluster",
+    "STRATEGIES",
+    "VirtualCluster",
+    "make_strategy",
+]

@@ -1,0 +1,190 @@
+"""Continuous safety-invariant checking for adversarial scenarios.
+
+The paper's quorum protocol claims safety under f Byzantine replicas.  This
+module turns that claim into a harness observable: an
+:class:`InvariantChecker` samples the HONEST replicas' stores while an
+adversarial workload runs and accumulates violations of three invariants —
+the record the config-10 benchmark publishes alongside each attack's
+latency cost:
+
+1. **Certificate agreement** — no two conflicting certificates commit for
+   the same object timestamp: every honest replica holding a committed
+   certificate for ``(key, certified_ts, configstamp)`` must have committed
+   the SAME transaction there (checked across replicas per sample, and
+   against every previous sample — an overwrite at an already-committed
+   timestamp is a violation even if the replicas momentarily agree).
+
+2. **Epoch monotonicity** — per (honest replica, key), ``current_epoch``
+   and the certified timestamp never move backwards (a replayed stale
+   certificate regressing a commit would trip this immediately).
+
+3. **Acked durability** — every write the workload saw acknowledged is
+   readable afterwards: :meth:`final_check` re-reads each acked key
+   through a real client (quorum read, with the SDK's recovery machinery —
+   that IS the system's contract) and requires the latest acked value.
+
+The checker never looks inside Byzantine replicas: the invariants
+constrain what the HONEST side of the cluster may do while <= f members
+behave arbitrarily.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..protocol import transaction_hash
+
+LOG = logging.getLogger(__name__)
+
+
+class InvariantChecker:
+    """``checker = InvariantChecker(vc.honest_replicas()); checker.start()``
+
+    ``record_ack(key, value)`` is called by the workload after every
+    acknowledged write (last ack per key wins — the protocol's last-write
+    semantics).  ``check_now()`` runs the store-level invariants once;
+    ``start()`` runs it on an interval until ``stop()``.  ``report()``
+    returns the verdict dict embedded in benchmark records.
+    """
+
+    def __init__(self, replicas: Sequence, byzantine_ids: Sequence[str] = ()):
+        self.replicas = [r for r in replicas if r.server_id not in set(byzantine_ids)]
+        self.byzantine_ids = sorted(set(byzantine_ids))
+        self.violations: List[str] = []
+        self.samples = 0
+        # (key, certified_ts, configstamp) -> txn hash, accumulated over
+        # every sample of every honest replica: invariant 1's memory.
+        self._committed: Dict[Tuple[str, int, int], bytes] = {}
+        # (server_id, key) -> (current_epoch, certified_ts): invariant 2.
+        self._progress: Dict[Tuple[str, str], Tuple[int, int]] = {}
+        # key -> latest acked value (None = acked delete): invariant 3.
+        self.acked: Dict[str, Optional[bytes]] = {}
+        self.acked_writes = 0
+        self._task: Optional[asyncio.Task] = None
+
+    # ------------------------------------------------------------- workload
+
+    def record_ack(self, key: str, value: Optional[bytes]) -> None:
+        self.acked[key] = value
+        self.acked_writes += 1
+
+    # ------------------------------------------------------------- sampling
+
+    def _violate(self, msg: str) -> None:
+        if len(self.violations) < 256:  # bounded evidence, not a log flood
+            self.violations.append(msg)
+        LOG.error("SAFETY INVARIANT VIOLATED: %s", msg)
+
+    def check_now(self) -> None:
+        """One pass of invariants 1 + 2 over the honest replicas' stores.
+        Synchronous by design: it runs between event-loop turns, where the
+        single-threaded stores are consistent."""
+        self.samples += 1
+        for replica in self.replicas:
+            sid = replica.server_id
+            cfg = replica.store.config
+            for key, sv in replica.store.data.items():
+                if sv.current_certificate is None or sv.last_transaction is None:
+                    continue
+                rset = set(cfg.replica_set_for_key(key))
+                cert_ts = sv.certificate_timestamp(rset)
+                if cert_ts is None:
+                    continue
+                txh = transaction_hash(sv.last_transaction)
+                stamp = cfg.configstamp
+                prev = self._committed.get((key, cert_ts, stamp))
+                if prev is None:
+                    self._committed[(key, cert_ts, stamp)] = txh
+                elif prev != txh:
+                    self._violate(
+                        f"conflicting commits for {key!r} at ts={cert_ts} "
+                        f"cs={stamp}: {prev.hex()[:16]} vs {txh.hex()[:16]} "
+                        f"(seen at {sid})"
+                    )
+                last = self._progress.get((sid, key))
+                if last is not None and (
+                    sv.current_epoch < last[0] or cert_ts < last[1]
+                ):
+                    self._violate(
+                        f"epoch/timestamp regression at {sid} for {key!r}: "
+                        f"epoch {last[0]}->{sv.current_epoch}, "
+                        f"cert_ts {last[1]}->{cert_ts}"
+                    )
+                self._progress[(sid, key)] = (sv.current_epoch, cert_ts)
+
+    async def _loop(self, interval_s: float) -> None:
+        while True:
+            await asyncio.sleep(interval_s)
+            try:
+                self.check_now()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                LOG.exception("invariant sample failed")
+
+    def start(self, interval_s: float = 0.05) -> None:
+        if self._task is None or self._task.done():
+            self._task = asyncio.ensure_future(self._loop(interval_s))
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass  # the cancellation we just requested
+            except Exception:
+                pass
+            self._task = None
+
+    # ---------------------------------------------------------------- final
+
+    async def final_check(self, client) -> None:
+        """Invariant 3 (acked durability), end to end: every acked key must
+        read back its latest acked value through a real quorum read —
+        client-side recovery (nudge + poll) is allowed; it is part of the
+        system under test."""
+        from ..client.txn import TransactionBuilder
+
+        self.check_now()
+        for key, value in sorted(self.acked.items()):
+            try:
+                res = await client.execute_read_transaction(
+                    TransactionBuilder().read(key).build()
+                )
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:
+                self._violate(
+                    f"acked write {key!r} unreadable from honest quorum: "
+                    f"{type(exc).__name__}: {exc}"
+                )
+                continue
+            op = res.operations[0]
+            got = bytes(op.value) if op.value is not None else None
+            if value is None:
+                if op.existed:
+                    self._violate(f"acked delete of {key!r} resurfaced {got!r}")
+            elif got != value:
+                self._violate(
+                    f"acked write {key!r} lost: read {got!r}, acked {value!r}"
+                )
+
+    # --------------------------------------------------------------- report
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def report(self) -> Dict:
+        return {
+            "ok": self.ok,
+            "samples": self.samples,
+            "keys_tracked": len(self.acked),
+            "acked_writes": self.acked_writes,
+            "honest_replicas": [r.server_id for r in self.replicas],
+            "byzantine_replicas": self.byzantine_ids,
+            "violations": list(self.violations),
+        }

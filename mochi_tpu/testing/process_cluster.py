@@ -129,6 +129,11 @@ class ProcessCluster:
         # thrash).  The client/driver process is left unpinned so the
         # scheduler can fill the remaining capacity.
         pin_cores: bool = False,
+        # Byzantine fault injection across a REAL process boundary:
+        # {server_id: strategy name} forwarded to the hosting child as
+        # ``--byzantine sid=strategy`` (testing/byzantine.py catalog) —
+        # the cross-process twin of VirtualCluster(byzantine=...).
+        byzantine: Optional[Dict[str, str]] = None,
     ):
         if n_processes is None:
             n_processes = min(n_servers, os.cpu_count() or 1)
@@ -147,6 +152,7 @@ class ProcessCluster:
         self.ready_timeout_s = ready_timeout_s
         self.drain_timeout_s = drain_timeout_s
         self.pin_cores = pin_cores
+        self.byzantine: Dict[str, str] = dict(byzantine or {})
         self._extra_env = dict(env or {})
         self.config: Optional[ClusterConfig] = None
         self.keypairs: Dict[str, KeyPair] = {}
@@ -164,6 +170,20 @@ class ProcessCluster:
         self._tmpdir = tempfile.TemporaryDirectory(prefix="mochi-pc-")
         out = self._tmpdir.name
         server_ids = [f"server-{i}" for i in range(self.n_servers)]
+        unknown = set(self.byzantine) - set(server_ids)
+        if unknown:
+            # mirror VirtualCluster: a typo'd id must fail loudly, not run
+            # an honest cluster under an adversarial label
+            raise ValueError(
+                f"byzantine map names unknown servers: {sorted(unknown)} "
+                f"(cluster has {server_ids})"
+            )
+        if self.byzantine:
+            # parent-side strategy validation spares a spawn-and-crash cycle
+            from .byzantine import make_strategy
+
+            for spec in self.byzantine.values():
+                make_strategy(spec)
         self.keypairs = {sid: generate_keypair() for sid in server_ids}
         if self.uds:
             paths = {sid: os.path.join(out, sid + ".sock") for sid in server_ids}
@@ -241,6 +261,9 @@ class ProcessCluster:
                     "--shed-lag-ms", str(self.shed_lag_ms),
                     "--drain-timeout", str(self.drain_timeout_s),
                 ]
+                for sid in group:
+                    if sid in self.byzantine:
+                        argv += ["--byzantine", f"{sid}={self.byzantine[sid]}"]
                 if self.admin_base_port is not None:
                     # process pi's replica j serves base + pi*n_servers + j
                     argv += ["--admin-port", str(self.admin_base_port + pi * self.n_servers)]

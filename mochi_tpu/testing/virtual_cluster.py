@@ -53,6 +53,14 @@ class VirtualCluster:
         # every vc.client() SDK instance, with the event schedule armed at
         # cluster start.  None (default): unconditioned loopback as before.
         netsim=None,
+        # Byzantine fault injection (testing/byzantine.py): {server_id:
+        # strategy} where strategy is a catalog name ("equivocate",
+        # "forge-cert", "stale-replay", "silent", "storm") or an
+        # AttackStrategy instance.  Mapped replicas boot as
+        # ByzantineReplica — the honest runtime with the strategy spliced
+        # into its batch seams — and KEEP the strategy across
+        # restart_replica (an adversary does not reform on reboot).
+        byzantine: Optional[Dict[str, object]] = None,
     ):
         self.n_servers = n_servers
         self.rf = rf
@@ -61,6 +69,7 @@ class VirtualCluster:
         self.host = host
         self.shed_lag_ms = shed_lag_ms
         self.netsim = netsim
+        self.byzantine: Dict[str, object] = dict(byzantine or {})
         # Unix-domain sockets instead of loopback TCP (per-replica socket
         # files under this dir): skips the TCP/IP stack on the kernel send
         # path, the measured cost floor for single-host clusters
@@ -99,6 +108,22 @@ class VirtualCluster:
             self.netsim.ensure_started()  # arm the link-event schedule at t=0
 
         server_ids = [f"server-{i}" for i in range(self.n_servers)]
+        unknown = set(self.byzantine) - set(server_ids)
+        if unknown:
+            # A typo'd id must not silently run an honest cluster while a
+            # benchmark record claims an attack leg.
+            raise ValueError(
+                f"byzantine map names unknown servers: {sorted(unknown)} "
+                f"(cluster has {server_ids})"
+            )
+        if self.byzantine:
+            # validate strategy names BEFORE any replica binds a socket —
+            # a mid-start-loop ValueError would leak the already-started
+            # replicas (__aexit__ never runs when __aenter__ raises)
+            from .byzantine import make_strategy
+
+            for spec in self.byzantine.values():
+                make_strategy(spec)
         self.keypairs = {sid: generate_keypair() for sid in server_ids}
 
         def host_for(sid: str) -> str:
@@ -115,17 +140,8 @@ class VirtualCluster:
             public_keys={sid: kp.public_key for sid, kp in self.keypairs.items()},
         )
         for sid in server_ids:
-            replica = MochiReplica(
-                server_id=sid,
-                config=placeholder,
-                keypair=self.keypairs[sid],
-                verifier=self.verifier_factory() if self.verifier_factory else None,
-                client_public_keys=self.client_keys,
-                require_client_auth=self.require_client_auth,
-                host=host_for(sid),
-                port=0,
-                shed_lag_ms=self.shed_lag_ms,
-                netsim=self.netsim,
+            replica = self._new_replica(
+                sid, placeholder, host_for(sid), 0, shed_lag_ms=self.shed_lag_ms
             )
             await replica.start()
             self.replicas.append(replica)
@@ -138,6 +154,39 @@ class VirtualCluster:
             replica.config = self.config
             replica.store.config = self.config
         return self
+
+    def _new_replica(
+        self, sid: str, config: ClusterConfig, host: str, port: int, **kwargs
+    ) -> MochiReplica:
+        """Construct one replica — honest, or a ByzantineReplica when the
+        ``byzantine`` map names this server (seeded per server id so each
+        adversary's decisions are deterministic run over run)."""
+        common = dict(
+            server_id=sid,
+            config=config,
+            keypair=self.keypairs[sid],
+            verifier=self.verifier_factory() if self.verifier_factory else None,
+            client_public_keys=self.client_keys,
+            require_client_auth=self.require_client_auth,
+            host=host,
+            port=port,
+            netsim=self.netsim,
+            **kwargs,
+        )
+        strategy = self.byzantine.get(sid)
+        if strategy is None:
+            return MochiReplica(**common)
+        from .byzantine import ByzantineReplica
+
+        return ByzantineReplica(
+            strategy=strategy,
+            strategy_seed=sum(sid.encode()),
+            **common,
+        )
+
+    def honest_replicas(self) -> List[MochiReplica]:
+        """The replicas the safety invariants constrain (testing/invariants)."""
+        return [r for r in self.replicas if r.server_id not in self.byzantine]
 
     def client(self, **kwargs) -> MochiDBClient:
         assert self.config is not None, "cluster not started"
@@ -167,17 +216,17 @@ class VirtualCluster:
         if old.verifier is not None:
             await old.verifier.close()
         await old.close()
-        fresh = MochiReplica(
-            server_id=server_id,
-            config=self.config,
-            keypair=self.keypairs[server_id],
-            verifier=self.verifier_factory() if self.verifier_factory else None,
-            client_public_keys=self.client_keys,
-            require_client_auth=self.require_client_auth,
-            # same endpoint the config advertises (UDS path or TCP host)
-            host=self.config.servers[server_id].host,
-            port=port,
-            netsim=self.netsim,
+        # same endpoint the config advertises (UDS path or TCP host); a
+        # byzantine-mapped server comes back byzantine (fresh strategy state)
+        fresh = self._new_replica(
+            server_id,
+            self.config,
+            self.config.servers[server_id].host,
+            port,
+            # keep the cluster's admission-control posture across restarts
+            # (the pre-round-11 restart path silently flipped restarted
+            # replicas to MochiReplica's 30 ms default)
+            shed_lag_ms=self.shed_lag_ms,
         )
         await fresh.start()
         self.replicas[self.replicas.index(old)] = fresh
