@@ -105,6 +105,8 @@ async def _leg(
     keys_per_client: int,
     sweeps: int,
     timeout_s: float,
+    drop: float = 0.0,
+    trim_write1: bool = False,
 ) -> Dict:
     from mochi_tpu.client.txn import TransactionBuilder
     from mochi_tpu.netsim import NetSim
@@ -112,7 +114,7 @@ async def _leg(
     from mochi_tpu.testing.virtual_cluster import VirtualCluster
     from mochi_tpu.utils.runtime import reset_gc_debt
 
-    sim = NetSim.mesh(seed=SEED, rtt_ms=RTT_MS, jitter_ms=JITTER_MS)
+    sim = NetSim.mesh(seed=SEED, rtt_ms=RTT_MS, jitter_ms=JITTER_MS, drop=drop)
     byzantine = {BYZ_SID: attack} if attack else None
     async with VirtualCluster(5, rf=4, netsim=sim, byzantine=byzantine) as vc:
         checker = InvariantChecker(
@@ -125,13 +127,23 @@ async def _leg(
         clients = []
 
         async def populate(ci: int):
-            client = vc.client(timeout_s=timeout_s)
+            client = vc.client(timeout_s=timeout_s, trim_write1=trim_write1)
             clients.append(client)
             for k in range(keys_per_client):
                 key = f"byz-{ci}-{k}"
-                await client.execute_write_transaction(
-                    TransactionBuilder().write(key, b"seed").build()
-                )
+                # app-level retry: on a lossy mesh a seed write can lose
+                # two answer frames and fail its tally — the retry IS the
+                # loss recovery (the timed workers count such failures;
+                # the seed phase just needs the keys to exist)
+                for attempt in range(4):
+                    try:
+                        await client.execute_write_transaction(
+                            TransactionBuilder().write(key, b"seed").build()
+                        )
+                        break
+                    except Exception:
+                        if attempt == 3:
+                            raise
                 checker.record_ack(key, b"seed")
 
         await asyncio.gather(*[populate(i) for i in range(n_clients)])
@@ -167,7 +179,12 @@ async def _leg(
                         )
                     except Exception:
                         # liveness cost, counted honestly; safety is the
-                        # checker's department
+                        # checker's department.  The failed write's outcome
+                        # is INDETERMINATE (under loss its Write2 frames
+                        # may have applied) — record it so final_check can
+                        # tell "superseded by an in-doubt later write"
+                        # apart from real acked-write loss.
+                        checker.record_attempt(key, val)
                         write_failures += 1
                         continue
                     write_lat.append(time.perf_counter() - t0)
@@ -223,6 +240,8 @@ async def _leg(
 
         return {
             "attack": attack or "honest",
+            "mesh_drop": drop,
+            "trim_write1": trim_write1,
             "read_ms": _pcts(read_lat),
             "write_ms": _pcts(write_lat),
             "read_samples": len(read_lat),
@@ -246,6 +265,18 @@ def run(
     sweeps: int = 3,
     attacks=ATTACKS,
     timeout_s: float = 2.0,
+    # ROADMAP item 4 remainder: the adversarial strategies whose cost is
+    # ALSO measured under packet loss (the clean 13 ms mesh flatters an
+    # attacker whose damage compounds with retries) — one extra leg each
+    # at ``loss_drop`` per-frame drop on every link.
+    loss_attacks=("storm", "silent"),
+    loss_drop: float = 0.02,
+    # trim_write1 suspicion-steering A/B (ISSUE 8 satellite): re-measure
+    # the off-by-default quorum-trimmed first Write1 attempt now that
+    # _quorum_targets deprioritizes suspects — against the SILENT
+    # adversary, where the trim historically wasted a full timeout per
+    # fan-out on the dead replica until suspicion converged.
+    trim_ab: bool = True,
 ) -> Dict:
     from mochi_tpu.net import transport
     from mochi_tpu.utils.runtime import tune_gc_for_server
@@ -253,6 +284,23 @@ def run(
     tune_gc_for_server()
     prev_floor = transport.RTT_FLOOR_S
     transport.RTT_FLOOR_S = max(prev_floor, RTT_MS / 1e3)
+
+    def _vs_honest(leg: Dict, honest: Dict) -> Dict:
+        return {
+            "write_p50_ratio": _ratio(
+                leg["write_ms"]["p50"], honest["write_ms"]["p50"]
+            ),
+            "write_p95_ratio": _ratio(
+                leg["write_ms"]["p95"], honest["write_ms"]["p95"]
+            ),
+            "read_p50_ratio": _ratio(
+                leg["read_ms"]["p50"], honest["read_ms"]["p50"]
+            ),
+            "read_p95_ratio": _ratio(
+                leg["read_ms"]["p95"], honest["read_ms"]["p95"]
+            ),
+        }
+
     try:
         honest = asyncio.run(_leg(None, n_clients, keys_per_client, sweeps, timeout_s))
         per_attack: Dict[str, Dict] = {}
@@ -260,21 +308,53 @@ def run(
             leg = asyncio.run(
                 _leg(attack, n_clients, keys_per_client, sweeps, timeout_s)
             )
-            leg["vs_honest"] = {
-                "write_p50_ratio": _ratio(
-                    leg["write_ms"]["p50"], honest["write_ms"]["p50"]
+            leg["vs_honest"] = _vs_honest(leg, honest)
+            per_attack[attack] = leg
+        for attack in loss_attacks:
+            leg = asyncio.run(
+                _leg(
+                    attack, n_clients, keys_per_client, sweeps, timeout_s,
+                    drop=loss_drop,
+                )
+            )
+            # paired against the CLEAN honest leg: the ratio then carries
+            # loss + adversary together — the deployment-facing number
+            # ("what does this attack cost me on a real lossy WAN")
+            leg["vs_honest"] = _vs_honest(leg, honest)
+            per_attack[f"{attack}+loss"] = leg
+        trim_ab_rec: Optional[Dict] = None
+        if trim_ab:
+            trim_legs = {}
+            # the clean-mesh silent leg (when it ran above) IS the
+            # trim=False side — parameter-identical; don't pay it twice
+            if "silent" in per_attack:
+                trim_legs["full"] = per_attack["silent"]
+            for trim in (False, True):
+                key = "trim" if trim else "full"
+                if key in trim_legs:
+                    continue
+                trim_legs[key] = asyncio.run(
+                    _leg(
+                        "silent", n_clients, keys_per_client, sweeps,
+                        timeout_s, trim_write1=trim,
+                    )
+                )
+            trim_ab_rec = {
+                "scenario": "silent adversary, clean mesh",
+                "full_fanout_write_ms": trim_legs["full"]["write_ms"],
+                "trim_write1_write_ms": trim_legs["trim"]["write_ms"],
+                "trim_vs_full_write_p50": _ratio(
+                    trim_legs["trim"]["write_ms"]["p50"],
+                    trim_legs["full"]["write_ms"]["p50"],
                 ),
-                "write_p95_ratio": _ratio(
-                    leg["write_ms"]["p95"], honest["write_ms"]["p95"]
-                ),
-                "read_p50_ratio": _ratio(
-                    leg["read_ms"]["p50"], honest["read_ms"]["p50"]
-                ),
-                "read_p95_ratio": _ratio(
-                    leg["read_ms"]["p95"], honest["read_ms"]["p95"]
+                "trim_leg_write_failures": trim_legs["trim"]["write_failures"],
+                "notes": (
+                    "suspicion-steered _quorum_targets now routes the "
+                    "trimmed first Write1 attempt away from suspect peers "
+                    "(client.py trim_write1); ratio < 1 means the trim "
+                    "wins under an unresponsive in-set replica"
                 ),
             }
-            per_attack[attack] = leg
     finally:
         transport.RTT_FLOOR_S = prev_floor
 
@@ -307,6 +387,8 @@ def run(
         },
         "honest": honest,
         "attacks": per_attack,
+        "loss_drop": loss_drop,
+        "trim_write1_ab": trim_ab_rec,
         "r09_reference": R09_HONEST,
         "notes": (
             "per-attack vs_honest ratios are paired against the in-run "

@@ -30,8 +30,7 @@ CONFIG_NAMES = {
     "6": "config6_bigcluster",
     "7": "config7_wan",
     "8": "config8_scaleout",
-    # config 9 is reserved for the open-loop front-end-scale benchmark
-    # (ROADMAP item "thousands of concurrent clients")
+    "9": "config9_overload",
     "10": "config10_byzantine",
 }
 
@@ -58,12 +57,21 @@ SMOKE_KWARGS = {
         n_servers=4, rf=4, process_counts=(1, 2), n_clients=2,
         keys_per_client=4, sweeps=1, pairs=1, ops_per_txn=2,
     ),
+    # the whole open-loop harness in seconds: a handful of sessions, one
+    # short knee rung + one overload leg, invariants + table bounds — the
+    # numbers are noise; the surface (ramp, generator, knee pick, record
+    # schema) is what smoke pins
+    "9": dict(
+        n_sessions=24, leg_s=0.8, probe_s=0.5, probe_workers=8,
+        ladder=(0.6, 1.0), overload_factors=(1.5,), rtt_ms=2.0,
+        jitter_ms=0.5, timeout_s=2.0, ramp_batch=12,
+    ),
     # one honest + one adversarial leg end-to-end (live ByzantineReplica,
     # invariant checker, evidence aggregation): the whole config-10
     # harness surface in seconds
     "10": dict(
         n_clients=1, keys_per_client=2, sweeps=1, attacks=("silent",),
-        timeout_s=1.0,
+        timeout_s=1.0, loss_attacks=(), trim_ab=False,
     ),
 }
 

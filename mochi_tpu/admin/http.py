@@ -51,6 +51,8 @@ quorum={quorum} &middot; {member}</p>
 <table>{verifier_rows}</table>
 <h2>Batching</h2>
 <table>{batching_rows}</table>
+<h2>Overload</h2>
+<table>{overload_rows}</table>
 <h2>Fan-out</h2>
 <table>{fanout_rows}</table>
 <h2>Byzantine evidence</h2>
@@ -270,6 +272,16 @@ def _byzantine_prom(replica) -> str:
     return "# TYPE mochi_byzantine gauge\n" + "".join(lines)
 
 
+def _overload_rows(replica) -> str:
+    """The "/" page Overload table: admission-control state and bounded-
+    table sizes, flattened to one row per numeric leaf."""
+    flat: list = []
+    _walk_numeric("", replica.overload_stats(), flat)
+    return "".join(
+        f"<tr><td>{_esc(k)}</td><td>{_esc(v)}</td></tr>" for k, v in flat
+    )
+
+
 def _batching_rows(metrics) -> str:
     """Occupancy/latency histograms of the batched hot path, one row per
     histogram: count, mean, and the non-empty buckets — the at-a-glance
@@ -391,6 +403,10 @@ class AdminServer(HttpJsonServer):
                         for name, h in sorted(r.metrics.histograms.items())
                     },
                     "sessions": len(getattr(r, "_sessions", {})),
+                    # admission control + bounded-state surface: shed
+                    # probability, deterministic load components, session-
+                    # table size/evictions (docs/OPERATIONS.md §4g)
+                    "overload": r.overload_stats(),
                     # early-quorum fan-out evidence from THIS process's
                     # registry (peers empty on a pure responder — the
                     # key stays so dashboards need no existence probe)
@@ -441,6 +457,17 @@ class AdminServer(HttpJsonServer):
                 )
             body += _fanout_prom(r.metrics, "server", r.server_id)
             body += _byzantine_prom(r)
+            # Overload/admission gauges as one stat-labeled family:
+            # mochi_shed{stat="shed_p"|"load"|"sendq_out_bytes"|
+            # "sessions.size"|...} — "is any replica shedding, and why?"
+            # is a single PromQL query (docs/OPERATIONS.md §4g).
+            shed_samples: list = []
+            _walk_numeric("", r.overload_stats(), shed_samples)
+            sid = _prom_esc(r.server_id)
+            body += "# TYPE mochi_shed gauge\n" + "".join(
+                f'mochi_shed{{stat="{k}",server="{sid}"}} {v}\n'
+                for k, v in shed_samples
+            )
             # Per-shard ownership/traffic gauges: one family, stat-labeled,
             # so "is any replica serving foreign-shard traffic?" is a single
             # PromQL query across the fleet.
@@ -489,6 +516,7 @@ class AdminServer(HttpJsonServer):
                 shard_rows=_rows(r.store.shard_stats()),
                 verifier_rows=_rows(verifier_stats(r.verifier)),
                 batching_rows=_batching_rows(r.metrics),
+                overload_rows=_overload_rows(r),
                 fanout_rows=_fanout_rows(r.metrics),
                 byzantine_rows=_byzantine_rows(r),
                 sessions=len(getattr(r, "_sessions", {})),

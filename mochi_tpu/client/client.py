@@ -161,11 +161,14 @@ class MochiDBClient:
     # full replica set; retries widen to the full set.  Off by default: it
     # saves f requests per write but measured SLOWER on the single-core
     # loopback bench (the skipped replica's grant was free parallelism
-    # there) — re-confirmed in the batched-hot-path round even with the
-    # ~650 us pure-Python grant signs, where the trim still lost ~35% of
-    # config-1 throughput to retry widening; on a real multi-host
-    # deployment the saved WAN round trips should win — measure per
-    # deployment.
+    # there; ~35% of config-1 throughput lost to retry widening, pure-
+    # python round).  The trimmed targets now come from the suspicion-
+    # steered _quorum_targets (round 12): against an UNRESPONSIVE in-set
+    # replica the trim no longer wastes a timeout per fan-out once
+    # suspicion converges — the round-12 A/B under the silent adversary
+    # (benchmarks/results_r12.json "trim_write1_ab") measures that
+    # scenario; the honest-loopback loss stands, so the default stays
+    # False — measure per deployment.
     trim_write1: bool = False
 
     def __post_init__(self) -> None:
@@ -408,6 +411,18 @@ class MochiDBClient:
             if isinstance(ack, RequestFailedFromServer) and self._server_signed(
                 sid, server_key, res
             ):
+                if ack.fail_type == FailType.OVERLOADED:
+                    # Handshake-storm valve on the replica (admission
+                    # control): honor the retry-after hint as a failure
+                    # TTL and stay on signed envelopes meanwhile —
+                    # re-knocking per request is exactly the storm the
+                    # valve exists to stop.
+                    self.metrics.mark(f"client.handshake-limited.{sid}")
+                    wait_s = max(1.0, ack.retry_after_ms / 1e3)
+                    self._session_refused[sid] = time.monotonic() + min(
+                        wait_s, SESSION_FAILURE_TTL_S
+                    )
+                    return
                 # AUTHENTICATED typed refusal (refusals to a signed
                 # handshake are themselves Ed25519-signed — _respond signs
                 # in-kind), not a forged ack: in the secure posture a
@@ -542,6 +557,22 @@ class MochiDBClient:
                 transaction, payload_factory, _retry=False, targets=targets,
             )
         return out
+
+    @staticmethod
+    async def _backoff_sleep(delay_s: float) -> None:
+        """Backoff sleeps ride the coalesced timer wheel: at front-end
+        scale thousands of clients sit in shed backoff simultaneously, and
+        a per-sleep TimerHandle would cost one loop wakeup each — the
+        wheel batches a quantum's worth into one.  Jitter dwarfs the
+        quantum, so coarseness is free here."""
+        from ..net.transport import TIMEOUT_WHEEL_QUANTUM_S
+
+        if TIMEOUT_WHEEL_QUANTUM_S > 0:
+            from ..utils.wakeup import wheel_for_loop
+
+            await wheel_for_loop(TIMEOUT_WHEEL_QUANTUM_S).sleep(delay_s)
+        else:
+            await asyncio.sleep(delay_s)
 
     async def close(self) -> None:
         await self.pool.close()
@@ -1029,9 +1060,30 @@ class MochiDBClient:
                                 )
                         else:
                             all_shed_rounds = 0
-                        await asyncio.sleep(
-                            0.02 * (1 << min(attempt, 4)) * (0.5 + self._rand.random())
+                        # Jittered exponential backoff, raised to the
+                        # replicas' retry-after hint (their backlog-drain
+                        # estimate) when one was sent: a shedding cluster
+                        # sets the retry cadence, not the client's
+                        # loopback-sized default.
+                        delay = (
+                            0.02 * (1 << min(attempt, 4))
+                            * (0.5 + self._rand.random())
                         )
+                        hint_ms = max(
+                            (
+                                p.retry_after_ms
+                                for p in responses.values()
+                                if isinstance(p, RequestFailedFromServer)
+                                and p.fail_type == FailType.OVERLOADED
+                            ),
+                            default=0,
+                        )
+                        if hint_ms > 0:
+                            delay = max(
+                                delay,
+                                hint_ms / 1e3 * (0.75 + 0.5 * self._rand.random()),
+                            )
+                        await self._backoff_sleep(delay)
                         continue
                     all_shed_rounds = 0
                     # Seed collision with another in-flight transaction,

@@ -107,6 +107,40 @@ def _backoff_delay_s(attempt: int, base_s: float, rng: Optional[random.Random] =
 RTT_FLOOR_S = float(os.environ.get("MOCHI_RTT_FLOOR_MS", "0")) / 1e3
 RTT_TIMEOUT_MULT = float(os.environ.get("MOCHI_RTT_TIMEOUT_MULT", "8"))
 
+# Per-connection send-queue watermarks (server side).  The transport's
+# write buffer is the ONLY place response bytes for a slow reader can
+# accumulate (the app-level ``_out`` buffer self-flushes at
+# flush_max_bytes): past the high watermark asyncio calls pause_writing,
+# which our protocol turns into pause_reading — a peer that won't drain
+# responses stops being allowed to feed requests, so per-connection memory
+# is bounded at ~high + flush_max_bytes regardless of peer behavior.
+# Resume fires at the low mark (hysteresis: no pause/resume flapping at
+# the boundary).
+SENDQ_HIGH = int(os.environ.get("MOCHI_SENDQ_HIGH", str(256 * 1024)))
+SENDQ_LOW = int(os.environ.get("MOCHI_SENDQ_LOW", str(64 * 1024)))
+
+# Client-side pending-map bound: correlation futures per connection.  Every
+# entry IS an in-flight request (resolved entries are popped), so the bound
+# is a back-pressure valve, not an eviction policy — a send past the cap
+# fails typed (the caller's retry/backoff path absorbs it) and NOTHING
+# in-flight is ever evicted: evicting a live future would orphan its
+# response and surface as a spurious timeout (pinned in
+# tests/test_overload.py).
+PENDING_MAX = int(os.environ.get("MOCHI_PENDING_MAX", "4096"))
+
+# Request-timeout wakeup coalescing (utils/wakeup.TimerWheel): thousands of
+# concurrent request timeouts share one coarse loop timer instead of one
+# TimerHandle each.  Quantum = max added latency on a TIMEOUT (never on a
+# response); 0 disables (per-request asyncio.wait_for, the old path).
+TIMEOUT_WHEEL_QUANTUM_S = float(
+    os.environ.get("MOCHI_TIMEOUT_WHEEL_MS", "20")
+) / 1e3
+
+
+class PendingLimitExceeded(ConnectionError):
+    """Connection's in-flight correlation map is full (MOCHI_PENDING_MAX):
+    the caller is outrunning the peer — back off, don't buffer more."""
+
 
 def apply_rtt_floor(timeout_s: float) -> float:
     """Raise a caller's timeout to the configured multiple of the RTT
@@ -267,10 +301,38 @@ class _RpcServerProtocol(_FramedProtocol):
         # Batch tasks span connections and outlive any one of them — they
         # are server-owned (RpcServer._tasks) by design.
         self._conn_tasks: set = set()
+        self._flow_paused = False  # write-buffer high-water reached
 
     def connection_made(self, transport) -> None:
         super().connection_made(transport)
+        # Backpressure watermarks: past `high` buffered response bytes the
+        # loop calls pause_writing -> we pause_reading (base class), so a
+        # slow reader self-throttles; resume at `low`.
+        try:
+            transport.set_write_buffer_limits(
+                high=self.server.sendq_high, low=self.server.sendq_low
+            )
+        except (AttributeError, NotImplementedError):
+            pass  # exotic transports keep their defaults
         self.server._protocols.add(self)
+
+    # flow-control accounting rides the base class's pause/resume-reading
+    # behavior: the server-wide paused count is the admission controller's
+    # "peers not draining" load component.
+    def pause_writing(self) -> None:
+        if not self._flow_paused:
+            self._flow_paused = True
+            self.server._paused_conns += 1
+            metrics = self.server.metrics
+            if metrics is not None:
+                metrics.mark("transport.sendq-paused")
+        super().pause_writing()
+
+    def resume_writing(self) -> None:
+        if self._flow_paused:
+            self._flow_paused = False
+            self.server._paused_conns -= 1
+        super().resume_writing()
 
     def frame_received(self, frame: bytes) -> None:
         try:
@@ -294,6 +356,7 @@ class _RpcServerProtocol(_FramedProtocol):
             touched.append(self)
         self._out += _LEN.pack(len(payload))
         self._out += payload
+        self.server._sendq_out_bytes += len(payload) + 4
         if len(self._out) >= self.server.flush_max_bytes:
             self.flush_now()  # byte budget exceeded mid-unit: bound memory
 
@@ -304,6 +367,7 @@ class _RpcServerProtocol(_FramedProtocol):
         if not self._out:
             return
         buf, self._out = self._out, bytearray()
+        self.server._sendq_out_bytes -= len(buf)
         if self.transport is None or self.transport.is_closing():
             return
         metrics = self.server.metrics
@@ -319,6 +383,10 @@ class _RpcServerProtocol(_FramedProtocol):
 
     def connection_lost(self, exc: Optional[Exception]) -> None:
         self.server._protocols.discard(self)
+        if self._flow_paused:  # paused count must not leak a dead conn
+            self._flow_paused = False
+            self.server._paused_conns -= 1
+        self.server._sendq_out_bytes -= len(self._out)
         for task in self._conn_tasks:
             task.cancel()
         if self._flush_timer is not None:
@@ -374,6 +442,8 @@ class RpcServer:
         metrics=None,
         flush_max_bytes: int = FLUSH_MAX_BYTES,
         flush_max_delay_s: float = FLUSH_MAX_DELAY_S,
+        sendq_high: int = SENDQ_HIGH,
+        sendq_low: int = SENDQ_LOW,
     ):
         self.host = host
         self.port = port
@@ -383,6 +453,8 @@ class RpcServer:
         self.metrics = metrics
         self.flush_max_bytes = flush_max_bytes
         self.flush_max_delay_s = flush_max_delay_s
+        self.sendq_high = sendq_high
+        self.sendq_low = sendq_low
         # single source of the "unix:" scheme logic (code-review r4: the
         # prefix was sliced inline in three methods)
         self._unix_path: Optional[str] = (
@@ -394,8 +466,45 @@ class RpcServer:
         self._ingress: List[Tuple[_RpcServerProtocol, Envelope]] = []
         self._drain_scheduled = False
         self._tasks: set = set()
+        # -- deterministic load signal (the admission controller's inputs;
+        # all event-counted, none wall-clock: a loop stall can only inflate
+        # these by the requests actually queued behind it, never by the
+        # stall duration itself — the flake mode that kept the old
+        # loop-lag shed OFF in every in-process harness).
+        self._paused_conns = 0        # connections past the send-queue high-water
+        self._sendq_out_bytes = 0     # response bytes buffered pre-flush
+        self._inflight_envs = 0       # envelopes inside async batch tasks
+        self._batch_ewma = 0.0        # EWMA of frames per drain tick
+        self._last_drain_t = 0.0      # idle-gap detector for the EWMA reset
 
     # ------------------------------------------------------- per-tick drain
+
+    def load_stats(self) -> Dict[str, float]:
+        """O(1) snapshot of the transport-side load signal (the inputs to
+        ``server.admission.AdmissionController``; also the /status
+        "overload" surface)."""
+        return {
+            "ingress_depth": len(self._ingress),
+            "inflight_envs": self._inflight_envs,
+            "batch_ewma": round(self._batch_ewma, 2),
+            "sendq_out_bytes": self._sendq_out_bytes,
+            "paused_conns": self._paused_conns,
+            "connections": len(self._protocols),
+        }
+
+    def send_queue_bytes(self) -> int:
+        """Total buffered response bytes: pre-flush ``_out`` buffers plus
+        the transports' own write buffers.  O(connections) — admin-surface
+        freshness, not hot-path accounting (load_stats is the O(1) view)."""
+        total = self._sendq_out_bytes
+        for proto in self._protocols:
+            t = proto.transport
+            if t is not None:
+                try:
+                    total += t.get_write_buffer_size()
+                except (AttributeError, NotImplementedError):
+                    pass
+        return total
 
     def _enqueue(self, proto: _RpcServerProtocol, env: Envelope) -> None:
         self._ingress.append((proto, env))
@@ -413,6 +522,19 @@ class RpcServer:
             return
         self._ingress = []
         t0 = time.perf_counter()
+        # Congestion EWMA: frames-per-tick grows with backlog (arrivals
+        # outpacing service stack up in kernel buffers and land together on
+        # the next poll), and is bounded by what peers actually sent — the
+        # deterministic load signal the shed controller reads.  The EWMA is
+        # only folded when frames arrive, so it would otherwise FREEZE at
+        # its last value across an idle gap and shed the first writes of
+        # the next burst; an idle gap resets it.  Using wall time here is
+        # safe in a way the retired lag signal was not: absence of traffic
+        # can only decay the signal — a stall still cannot inflate it.
+        if t0 - self._last_drain_t > 1.0:
+            self._batch_ewma = 0.0
+        self._last_drain_t = t0
+        self._batch_ewma += 0.2 * (len(batch) - self._batch_ewma)
         metrics = self.metrics
         if metrics is not None:
             metrics.histogram("transport.drain-frames").observe(len(batch))
@@ -523,6 +645,11 @@ class RpcServer:
         task.add_done_callback(_done)
 
     async def _run_batch(self, batch: List[Tuple[_RpcServerProtocol, Envelope]]) -> None:
+        # counted from the coroutine's FIRST step, not the spawn site: a
+        # task cancelled before it ever runs (connection churn, shutdown)
+        # never enters this frame — increment-at-spawn would leak the
+        # counter permanently and drift the admission load signal upward
+        self._inflight_envs += len(batch)
         try:
             responses = await self.batch_handler([env for _, env in batch])
         except asyncio.CancelledError:
@@ -533,6 +660,8 @@ class RpcServer:
             # a handler BUG — log and drop, client timeouts recover.
             LOG.exception("batch handler failed for %d envelopes", len(batch))
             return
+        finally:
+            self._inflight_envs -= len(batch)
         touched: List[_RpcServerProtocol] = []
         try:
             self._queue_responses(batch, responses, touched)
@@ -561,6 +690,7 @@ class RpcServer:
             proto.queue_frame(frame, touched)
 
     async def _handle_async(self, proto: _RpcServerProtocol, env: Envelope) -> None:
+        self._inflight_envs += 1  # first-step counting; see _run_batch
         try:
             response = await self.handler(env)
         except asyncio.CancelledError:
@@ -572,6 +702,8 @@ class RpcServer:
             # but the failure taxonomy (RequestFailedFromServer) is preferred.
             LOG.exception("handler failed for %s", type(env.payload).__name__)
             return
+        finally:
+            self._inflight_envs -= 1
         if response is not None:
             touched: List[_RpcServerProtocol] = []
             try:
@@ -743,14 +875,33 @@ class _RpcClientProtocol(_FramedProtocol):
 
 
 class _Connection:
-    def __init__(self, info: ServerInfo, links=None):
+    def __init__(self, info: ServerInfo, links=None, pending_max: int = 0):
         self.info = info
         # (egress, ingress) LinkPolicy pair from NetSim.link_pair, or None:
         # attached to every protocol this connection (re)creates.
         self.links = links
         self.pending: Dict[str, asyncio.Future] = {}
+        self.pending_max = pending_max if pending_max > 0 else PENDING_MAX
         self._proto: Optional[_RpcClientProtocol] = None
         self._connect_lock = asyncio.Lock()
+
+    def register_pending(self, msg_id: str, fut: asyncio.Future) -> None:
+        """Correlation-map insert behind the in-flight bound.  At the cap,
+        already-resolved leftovers are swept (futures a raced caller never
+        popped); live in-flight entries are NEVER evicted — past the cap
+        the NEW request fails typed instead (the map's entries each back an
+        awaiting caller; evicting one manufactures a spurious timeout)."""
+        pending = self.pending
+        if len(pending) >= self.pending_max:
+            done = [mid for mid, f in pending.items() if f.done()]
+            for mid in done:
+                del pending[mid]
+            if len(pending) >= self.pending_max:
+                raise PendingLimitExceeded(
+                    f"{self.info.url}: {len(pending)} requests in flight "
+                    f"(MOCHI_PENDING_MAX={self.pending_max})"
+                )
+        pending[msg_id] = fut
 
     @property
     def connected(self) -> bool:
@@ -817,10 +968,32 @@ class _Connection:
         assert self._proto is not None
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
-        self.pending[env.msg_id] = fut
+        self.register_pending(env.msg_id, fut)
+        timeout = apply_rtt_floor(timeout_s)
         try:
             self._proto.send_frame(encode_envelope(env))
-            return await asyncio.wait_for(fut, apply_rtt_floor(timeout_s))
+            if TIMEOUT_WHEEL_QUANTUM_S > 0 and timeout > 0:
+                # Coalesced timeout: one coarse wheel tick covers every
+                # request expiring in the same quantum (a timeout may fire
+                # up to one quantum late; responses are unaffected).
+                from ..utils.wakeup import wheel_for_loop
+
+                def _expire() -> None:
+                    if not fut.done():
+                        fut.set_exception(
+                            asyncio.TimeoutError(
+                                f"no response from {self.info.url} in {timeout}s"
+                            )
+                        )
+
+                entry = wheel_for_loop(TIMEOUT_WHEEL_QUANTUM_S).call_at(
+                    loop.time() + timeout, _expire
+                )
+                try:
+                    return await fut
+                finally:
+                    entry.cancel()
+            return await asyncio.wait_for(fut, timeout)
         finally:
             self.pending.pop(env.msg_id, None)
 
@@ -1048,8 +1221,11 @@ async def fan_out(
             continue
         env = make_envelope(new_msg_id(), sid)
         fut = loop.create_future()
-        conn.pending[env.msg_id] = fut
         try:
+            # the same in-flight bound as send_and_receive: a full map
+            # fails THIS leg typed (the caller's quorum math sees one more
+            # error) instead of growing without bound
+            conn.register_pending(env.msg_id, fut)
             assert conn._proto is not None
             conn._proto.send_frame(encode_envelope(env))
         except Exception as exc:

@@ -414,18 +414,36 @@ class Write2AnsFromServer:
 
 @dataclass(frozen=True)
 class RequestFailedFromServer:
-    """Typed failure response (ref: ``MochiProtocol.proto:168-174``)."""
+    """Typed failure response (ref: ``MochiProtocol.proto:168-174``).
+
+    ``retry_after_ms`` (OVERLOADED only, 0 = no hint): the replica's
+    backlog-drain estimate — the client's backoff path waits at least this
+    long (jittered) before retrying, so a shedding cluster is not hammered
+    at the client's loopback-sized retry cadence."""
 
     fail_type: FailType
     detail: str = ""
+    retry_after_ms: int = 0
 
     def to_obj(self) -> Any:
+        # The third element rides the wire only when it carries
+        # information, so every failure EXCEPT a hinted OVERLOADED shed
+        # stays byte-identical to the pre-round-12 form.  Same upgrade
+        # posture as SyncRequestToServer's prefix field: new readers
+        # tolerate the old form; an old reader facing the NEW form (a
+        # hinted shed from an upgraded replica) fails decode and recovers
+        # by timeout — upgrade replicas before long-lived clients if shed
+        # hints matter during the transition.
+        if self.retry_after_ms:
+            return [int(self.fail_type), self.detail, self.retry_after_ms]
         return [int(self.fail_type), self.detail]
 
     @classmethod
     def from_obj(cls, obj: Any) -> "RequestFailedFromServer":
-        ft, detail = obj
-        return cls(FailType(ft), detail)
+        # tolerate the 2-field pre-retry-after wire form (rolling upgrades)
+        ft, detail = obj[:2]
+        retry_after_ms = obj[2] if len(obj) > 2 else 0
+        return cls(FailType(ft), detail, retry_after_ms)
 
 
 @dataclass(frozen=True)

@@ -151,7 +151,13 @@ async def amain(args) -> None:
             port=info.port,
             snapshot_path=snapshot_path,
             snapshot_interval_s=args.snapshot_interval,
-            shed_lag_ms=args.shed_lag_ms,
+            # explicit --admission wins; the deprecated --shed-lag-ms alias
+            # only applies when the new flag was not passed; default on
+            admission=(
+                args.admission == "on"
+                if args.admission is not None
+                else (args.shed_lag_ms is None or args.shed_lag_ms > 0)
+            ),
             **replica_kwargs,
         )
         await replica.start()
@@ -274,12 +280,21 @@ def main(argv=None) -> None:
         "config.admin_keys is set)",
     )
     parser.add_argument(
+        "--admission",
+        choices=("on", "off"),
+        default=None,  # unset: the deprecated --shed-lag-ms alias may apply
+        help="overload admission control (deterministic load signal: "
+        "dispatch pressure + verify occupancy + send-queue pressure — "
+        "server/admission.py; docs/OPERATIONS.md §4g): shed new Write1s "
+        "with typed OVERLOADED + retry-after once load exceeds the "
+        "MOCHI_SHED_* high-water marks",
+    )
+    parser.add_argument(
         "--shed-lag-ms",
         type=float,
-        default=30.0,
-        help="overload admission control: shed new Write1s when event-loop "
-        "lag EWMA exceeds this (0 disables; recommended when several "
-        "replicas share this process's loop — see testing/virtual_cluster)",
+        default=None,
+        help="DEPRECATED alias for --admission (the wall-clock lag signal "
+        "is retired): 0 maps to off, any positive value to on",
     )
     parser.add_argument(
         "--byzantine",

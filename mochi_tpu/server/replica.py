@@ -58,6 +58,7 @@ from ..protocol import (
 )
 from ..utils.metrics import Metrics
 from ..verifier.spi import CpuVerifier, SignatureVerifier, VerifyItem
+from .admission import AdmissionController, SessionTable, TokenBucket
 from .store import BadRequest, DataStore
 
 LOG = logging.getLogger(__name__)
@@ -103,7 +104,8 @@ class MochiReplica:
         port: int = 8081,  # ref default port: MochiServer.java:33-34
         snapshot_path: Optional[str] = None,
         snapshot_interval_s: float = 0.0,
-        shed_lag_ms: float = 30.0,
+        admission: Optional[bool] = None,
+        shed_lag_ms: Optional[float] = None,
         netsim=None,
     ):
         self.server_id = server_id
@@ -142,8 +144,11 @@ class MochiReplica:
         self._snapshot_write_fut: Optional[asyncio.Future] = None
         # sender_id -> session MAC key (crypto/session.py): envelope auth at
         # HMAC cost; Ed25519 reserved for MultiGrants.  Lost on restart —
-        # clients re-handshake when their MAC'd request bounces.
-        self._sessions: Dict[str, bytes] = {}
+        # clients re-handshake when their MAC'd request bounces.  Bounded
+        # LRU + idle TTL (server/admission.SessionTable): at front-end
+        # scale thousands of client sessions must cost bounded memory, and
+        # an evicted client transparently re-handshakes.
+        self._sessions = SessionTable()
         # signing_bytes -> signature for MultiGrants THIS replica issued at
         # write1: the write2 own-grant check becomes a compare instead of a
         # deterministic re-sign (~57 us saved per write2).  Bounded FIFO; a
@@ -156,19 +161,22 @@ class MochiReplica:
         # /status "byzantine" and the mochi_byzantine prom family).
         self._grant_ledger: Dict[tuple, tuple] = {}
         self._equivocations: Dict[str, int] = {}
-        # Admission control (overload shedding): a heartbeat task measures
-        # event-loop scheduling lag; when its EWMA exceeds ``shed_lag_ms``
-        # the replica sheds NEW transactions (Write1 -> OVERLOADED) while
-        # still finishing admitted ones (Write2, reads).  This bounds the
-        # service-time tail and prevents the throughput collapse an
-        # unbounded backlog causes; 0 disables.  The reference has no
-        # admission control at all (its 2-thread pool just queues,
-        # MochiServer.java:36-54).
-        self.shed_lag_ms = shed_lag_ms
-        self._overloaded = False
-        self._lag_ewma_ms = 0.0
-        self._shed_p = 0.0
-        self._lag_task: Optional[asyncio.Task] = None
+        # Admission control (overload shedding), ON by default: the
+        # deterministic load signal in server/admission.py — dispatch
+        # pressure, verify occupancy, send-queue pressure, all
+        # event-counted — drives a shed probability; the replica sheds NEW
+        # transactions (Write1 -> OVERLOADED + retry-after hint) while
+        # still finishing admitted ones (Write2, reads), bounding the
+        # service-time tail instead of collapsing under backlog.  The
+        # reference has no admission control at all (its 2-thread pool
+        # just queues, MochiServer.java:36-54).  ``shed_lag_ms`` is the
+        # retired wall-clock signal's knob, kept as an on/off alias
+        # (0 = off) for older call sites.
+        if admission is None:
+            admission = shed_lag_ms is None or shed_lag_ms > 0
+        self._admission = AdmissionController(self.rpc, enabled=admission)
+        self._handshakes = TokenBucket()
+        self._sweep_countdown = 1024
         # Reconfiguration (paper mochiDB.tex:184-199): a committed write to
         # CONFIG_CLUSTER_KEY installs the new membership live.
         self.store.on_config_value = self._install_config
@@ -200,8 +208,6 @@ class MochiReplica:
         await self.rpc.start()
         if self.snapshot_path and self.snapshot_interval_s > 0:
             self._snapshot_task = asyncio.ensure_future(self._snapshot_loop())
-        if self.shed_lag_ms > 0:
-            self._lag_task = asyncio.ensure_future(self._lag_monitor())
 
     @staticmethod
     def _shed_draw(payload) -> float:
@@ -223,30 +229,32 @@ class MochiReplica:
         h = zlib.crc32(f"{payload.client_id}:{payload.seed}".encode())
         return (h & 0xFFFFFFFF) / 4294967296.0
 
-    async def _lag_monitor(self, interval_s: float = 0.02) -> None:
-        """EWMA of event-loop scheduling lag — the congestion signal for
-        admission control.  Lag is how much later than requested the sleep
-        wakes: directly the queueing delay every request on this loop is
-        experiencing."""
-        loop = asyncio.get_running_loop()
-        while True:
-            t0 = loop.time()
-            await asyncio.sleep(interval_s)
-            lag_ms = max(0.0, (loop.time() - t0 - interval_s)) * 1e3
-            self._lag_ewma_ms += 0.3 * (lag_ms - self._lag_ewma_ms)
-            was = self._overloaded
-            self._overloaded = self._lag_ewma_ms > self.shed_lag_ms
-            # Proportional controller, not a hard gate: drive the shed
-            # probability by the RELATIVE lag error so it settles at the
-            # actual excess-demand fraction instead of saturating (an
-            # all-or-nothing gate measured 6x WORSE goodput than no
-            # shedding at 1.8x overload — retries amplify a full shutter;
-            # a saturating ramp overshot to p=0.9 and halved goodput while
-            # the backlog it had already admitted drained).
-            err = (self._lag_ewma_ms - self.shed_lag_ms) / self.shed_lag_ms
-            self._shed_p = min(0.9, max(0.0, self._shed_p + 0.04 * max(-1.0, min(1.0, err))))
-            if self._overloaded and not was:
-                self.metrics.mark("replica.overload-entered")
+    @property
+    def _shed_p(self) -> float:
+        return self._admission.shed_p
+
+    @_shed_p.setter
+    def _shed_p(self, p: float) -> None:
+        # Test seam (and the old attribute's name): assigning pins the
+        # controller at exactly that probability; assign None via
+        # ``self._admission.pin(None)`` to unfreeze.
+        self._admission.pin(p)
+
+    def overload_stats(self) -> Dict[str, object]:
+        """The /status "overload" surface (admin/http.py): controller
+        state, transport load signal, bounded-table sizes."""
+        was = self._admission.overloaded
+        self._admission.update()
+        if self._admission.overloaded and not was:
+            self.metrics.mark("replica.overload-entered")
+        st = self._admission.stats()
+        # full send-queue total incl. the transports' own write buffers
+        # (O(connections) — admin freshness, not the hot-path signal)
+        st["sendq_total_bytes"] = self.rpc.send_queue_bytes()
+        st["sessions"] = self._sessions.stats()
+        st["handshake_refused"] = self._handshakes.refused
+        st["write1_shed"] = self.metrics.counters.get("replica.write1-shed", 0)
+        return st
 
     async def _snapshot_loop(self) -> None:
         from . import persistence
@@ -281,15 +289,6 @@ class MochiReplica:
         await self.rpc.quiesce(timeout_s)
 
     async def close(self) -> None:
-        if self._lag_task is not None:
-            self._lag_task.cancel()
-            try:
-                await self._lag_task
-            except asyncio.CancelledError:
-                pass  # the cancellation we just requested
-            except Exception:
-                pass
-            self._lag_task = None
         if self._snapshot_task is not None:
             # Await the cancelled loop AND any in-flight executor write: an
             # unawaited periodic os.replace could otherwise land AFTER the
@@ -543,6 +542,24 @@ class MochiReplica:
     async def handle_batch(
         self, envs: "Sequence[Envelope]"
     ) -> "List[Optional[Envelope]]":
+        """Async-half entry point: pins each MAC'd sender's session for the
+        batch's lifetime (the table's LRU eviction must never drop a
+        session between an envelope's auth check and its response seal —
+        the batch spans verifier awaits where a handshake burst could
+        otherwise evict it), then runs the real pipeline."""
+        sessions = self._sessions
+        pinned = [env.sender_id for env in envs if env.mac is not None]
+        for s in pinned:
+            sessions.pin(s)
+        try:
+            return await self._handle_batch_pipeline(envs)
+        finally:
+            for s in pinned:
+                sessions.unpin(s)
+
+    async def _handle_batch_pipeline(
+        self, envs: "Sequence[Envelope]"
+    ) -> "List[Optional[Envelope]]":
         """Async half of the drain: everything that may need real signature
         work.  Envelope-auth checks AND Write2 certificate checks for the
         whole batch ride ONE ``verify_batch`` round trip (single bitmap,
@@ -663,7 +680,7 @@ class MochiReplica:
         if items:
             metrics.histogram("replica.verify-occupancy").observe(len(items))
             with metrics.timer("replica.auth-verify"):
-                bitmap = await self.verifier.verify_batch(items)
+                bitmap = await self._verify_counted(items)
         else:
             bitmap = []
 
@@ -704,7 +721,7 @@ class MochiReplica:
             if items2:
                 metrics.histogram("replica.verify-occupancy").observe(len(items2))
                 with metrics.timer("replica.auth-verify"):
-                    bitmap2 = await self.verifier.verify_batch(items2)
+                    bitmap2 = await self._verify_counted(items2)
             else:
                 bitmap2 = []
         else:
@@ -765,6 +782,16 @@ class MochiReplica:
                     self._kick_sync_worker()
                 out[i] = self._respond(env, result)
         return out
+
+    async def _verify_counted(self, items: "List[VerifyItem]"):
+        """verify_batch with admission-control occupancy accounting: items
+        awaiting the verifier are the write path's service-center backlog —
+        one of the deterministic load components (server/admission.py)."""
+        self._admission.verify_inflight += len(items)
+        try:
+            return await self.verifier.verify_batch(items)
+        finally:
+            self._admission.verify_inflight -= len(items)
 
     def _dispatch_one(
         self,
@@ -867,6 +894,23 @@ class MochiReplica:
         )
 
     def _session_init(self, env: Envelope, payload: SessionInitToServer) -> Envelope:
+        # Handshake-storm valve: X25519+Ed25519 handshakes are the most
+        # expensive unauthenticated work this replica performs — a storm
+        # must not buy unbounded CPU (or churn the session table's LRU).
+        # The typed OVERLOADED refusal carries a retry-after hint; the
+        # client's failure TTL (SESSION_FAILURE_TTL_S) keeps it on signed
+        # envelopes meanwhile, so liveness only loses the MAC discount.
+        if not self._handshakes.admit():
+            self.metrics.mark("replica.handshake-limited")
+            return self._respond(
+                env,
+                RequestFailedFromServer(
+                    FailType.OVERLOADED,
+                    "session handshake rate limited; retry later",
+                    self._handshakes.retry_after_ms(),
+                ),
+                force_sign=True,
+            )
         # The ack must be Ed25519-SIGNED (not MAC'd): its signature is
         # what proves to the initiator that no MITM swapped X25519 keys.
         # A MAC'd handshake request is meaningless — require signature
@@ -897,6 +941,20 @@ class MochiReplica:
         then the grant signatures (synchronous host crypto, counted in
         replica.crypto-local like every sign this replica performs)."""
         metrics = self.metrics
+        # Refresh the shed probability from the deterministic load signal
+        # once per Write1 batch — the only admission point, so the O(1)
+        # update needs no timer task (and a pinned controller stays put).
+        admission = self._admission
+        was_over = admission.overloaded
+        admission.update()
+        if admission.overloaded and not was_over:
+            metrics.mark("replica.overload-entered")
+        self._sweep_countdown -= 1
+        if self._sweep_countdown <= 0:
+            # amortized idle-session TTL sweep (O(sessions), every ~1k
+            # write1 batches): idle memory reclaimed while traffic pays
+            self._sweep_countdown = 1024
+            self._sessions.sweep()
         out: List[Optional[Envelope]] = [None] * len(envs)
         reqs: List[Write1ToServer] = []
         req_idx: List[int] = []
@@ -931,7 +989,9 @@ class MochiReplica:
                     out[i] = self._respond(
                         env,
                         RequestFailedFromServer(
-                            FailType.OVERLOADED, "overloaded; retry with backoff"
+                            FailType.OVERLOADED,
+                            "overloaded; retry with backoff",
+                            admission.retry_after_ms,
                         ),
                     )
                 else:
@@ -1242,5 +1302,5 @@ class MochiReplica:
         """
         prep = self._prepare_certificate(wc)
         items = prep[2]
-        bitmap = await self.verifier.verify_batch(items) if items else []
+        bitmap = await self._verify_counted(items) if items else []
         return self._finish_certificate(wc, prep, bitmap)

@@ -62,6 +62,14 @@ class InvariantChecker:
         # key -> latest acked value (None = acked delete): invariant 3.
         self.acked: Dict[str, Optional[bytes]] = {}
         self.acked_writes = 0
+        # key -> values of writes whose client call failed AFTER dispatch
+        # (timeout, tally shortfall on a lossy link): outcome indeterminate
+        # — the write may have committed even though the workload saw an
+        # error.  final_check accepts these at read-back; a later ack for
+        # the key clears them (an older-timestamp write can no longer
+        # legally win).
+        self._in_doubt: Dict[str, set] = {}
+        self.in_doubt_accepted = 0
         self._task: Optional[asyncio.Task] = None
 
     # ------------------------------------------------------------- workload
@@ -69,6 +77,17 @@ class InvariantChecker:
     def record_ack(self, key: str, value: Optional[bytes]) -> None:
         self.acked[key] = value
         self.acked_writes += 1
+        self._in_doubt.pop(key, None)
+
+    def record_attempt(self, key: str, value: Optional[bytes]) -> None:
+        """A write the client dispatched but saw FAIL (exception after the
+        protocol may have reached replicas): its value is in doubt — under
+        frame loss the cluster can have committed it even though the
+        caller got an error.  Reading it back later is NOT acked-write
+        loss (the acked value was superseded by a later, newer-timestamp
+        write); reading anything outside acked+in-doubt still is."""
+        if value is not None:
+            self._in_doubt.setdefault(key, set()).add(value)
 
     # ------------------------------------------------------------- sampling
 
@@ -168,9 +187,14 @@ class InvariantChecker:
                 if op.existed:
                     self._violate(f"acked delete of {key!r} resurfaced {got!r}")
             elif got != value:
-                self._violate(
-                    f"acked write {key!r} lost: read {got!r}, acked {value!r}"
-                )
+                if got in self._in_doubt.get(key, ()):
+                    # an indeterminate (failed-at-client, committed-at-
+                    # cluster) later write won — durability held
+                    self.in_doubt_accepted += 1
+                else:
+                    self._violate(
+                        f"acked write {key!r} lost: read {got!r}, acked {value!r}"
+                    )
 
     # --------------------------------------------------------------- report
 
@@ -184,6 +208,7 @@ class InvariantChecker:
             "samples": self.samples,
             "keys_tracked": len(self.acked),
             "acked_writes": self.acked_writes,
+            "in_doubt_reads_accepted": self.in_doubt_accepted,
             "honest_replicas": [r.server_id for r in self.replicas],
             "byzantine_replicas": self.byzantine_ids,
             "violations": list(self.violations),

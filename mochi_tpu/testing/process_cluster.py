@@ -114,11 +114,11 @@ class ProcessCluster:
         # for free from its shared module caches and a real multi-process
         # deployment otherwise loses.
         verifier: str = "cpu",
-        # Multiple replicas share a child's loop below n_processes ==
-        # n_servers, where loop-lag admission control would shed in
-        # response to the harness (same rationale as VirtualCluster);
-        # process-per-replica deployments can turn it back on.
-        shed_lag_ms: float = 0.0,
+        # Admission control (deterministic load signal, server/admission.py)
+        # defaults ON in every posture — the queued-work signal cannot be
+        # tripped by replicas sharing a child's loop the way the retired
+        # wall-clock lag signal was.
+        admission: bool = True,
         admin_base_port: Optional[int] = None,
         data_dir: Optional[str] = None,
         ready_timeout_s: float = 60.0,
@@ -146,7 +146,7 @@ class ProcessCluster:
         self.n_processes = n_processes
         self.uds = uds and os.name == "posix"
         self.verifier = verifier
-        self.shed_lag_ms = shed_lag_ms
+        self.admission = admission
         self.admin_base_port = admin_base_port
         self.data_dir = data_dir
         self.ready_timeout_s = ready_timeout_s
@@ -258,7 +258,7 @@ class ProcessCluster:
                     argv += ["--seed-file", os.path.join(out, f"{sid}.seed")]
                 argv += [
                     "--verifier", replica_verifier,
-                    "--shed-lag-ms", str(self.shed_lag_ms),
+                    "--admission", "on" if self.admission else "off",
                     "--drain-timeout", str(self.drain_timeout_s),
                 ]
                 for sid in group:

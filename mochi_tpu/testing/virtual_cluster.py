@@ -37,15 +37,17 @@ class VirtualCluster:
         verifier_factory: Optional[Callable[[], SignatureVerifier]] = None,
         require_client_auth: bool = False,
         host: str = "127.0.0.1",
-        # Lag-based admission control is OFF in-process (real servers keep
-        # the 30 ms default): all rf replicas share one event loop, where
-        # first-use JAX compiles and (without the `cryptography` wheel)
-        # multi-ms pure-Python signature checks stall *everyone* — the lag
-        # monitor then sheds Write1s in response to the harness, not the
-        # system, and tests driving raw envelopes fail OVERLOADED at
-        # random.  test_backpressure pins ``_shed_p`` directly, which works
-        # without the monitor.
-        shed_lag_ms: float = 0.0,
+        # Admission control defaults ON — including in-process.  The PR-1
+        # era wall-clock loop-lag signal had to be disabled here (JAX
+        # compiles and pure-Python crypto stall the shared loop, and the
+        # lag monitor shed Write1s in response to the HARNESS); the
+        # replacement signal (server/admission.py) counts only queued
+        # work, which a stall cannot inflate beyond what clients actually
+        # sent, so the flake mode is gone.  ``admission=False`` opts a
+        # cluster out; ``shed_lag_ms`` is the retired knob kept as an
+        # on/off alias (0 = off) for older call sites.
+        admission: Optional[bool] = None,
+        shed_lag_ms: Optional[float] = None,
         uds_dir: Optional[str] = None,
         # Network conditioning (mochi_tpu.netsim.NetSim): a topology spec —
         # e.g. NetSim.mesh(seed=8, rtt_ms=13, jitter_ms=1) for "full mesh,
@@ -67,7 +69,9 @@ class VirtualCluster:
         self.verifier_factory = verifier_factory
         self.require_client_auth = require_client_auth
         self.host = host
-        self.shed_lag_ms = shed_lag_ms
+        if admission is None:
+            admission = shed_lag_ms is None or shed_lag_ms > 0
+        self.admission = admission
         self.netsim = netsim
         self.byzantine: Dict[str, object] = dict(byzantine or {})
         # Unix-domain sockets instead of loopback TCP (per-replica socket
@@ -141,7 +145,7 @@ class VirtualCluster:
         )
         for sid in server_ids:
             replica = self._new_replica(
-                sid, placeholder, host_for(sid), 0, shed_lag_ms=self.shed_lag_ms
+                sid, placeholder, host_for(sid), 0, admission=self.admission
             )
             await replica.start()
             self.replicas.append(replica)
@@ -225,8 +229,8 @@ class VirtualCluster:
             port,
             # keep the cluster's admission-control posture across restarts
             # (the pre-round-11 restart path silently flipped restarted
-            # replicas to MochiReplica's 30 ms default)
-            shed_lag_ms=self.shed_lag_ms,
+            # replicas to MochiReplica's default)
+            admission=self.admission,
         )
         await fresh.start()
         self.replicas[self.replicas.index(old)] = fresh

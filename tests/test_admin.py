@@ -49,6 +49,19 @@ async def _main():
             if replica.server_id in replica.config.replica_set_for_key("adm-key"):
                 assert shard["write1_owned"] >= 1 and shard["write2_applied"] >= 1
             assert shard["write1_foreign"] == 0 and shard["read_foreign"] == 0
+            # admission-control surface (docs/OPERATIONS.md §4g): the
+            # deterministic load signal, shed state, and bounded-table
+            # sizes — admission defaults ON, nothing shed at this load
+            ov = doc["overload"]
+            assert ov["enabled"] is True and ov["shed_p"] == 0.0
+            assert ov["overloaded"] is False and ov["write1_shed"] == 0
+            assert ov["sessions"]["size"] >= 1  # the client's MAC session
+            assert ov["sessions"]["size"] <= ov["sessions"]["max"]
+            assert ov["sessions"]["evictions"] == 0
+            for k in ("load", "batch_ewma", "inflight_envs",
+                      "sendq_out_bytes", "sendq_total_bytes",
+                      "paused_conns", "verify_inflight", "retry_after_ms"):
+                assert k in ov, k
 
             status, _, body = await loop.run_in_executor(None, _get, port, "/metrics")
             assert status == 200
@@ -60,6 +73,10 @@ async def _main():
             assert status == 200 and "text/plain" in ctype
             assert "mochi_counter_total{" in body or "mochi_timer_count{" in body
             assert f'server="{replica.server_id}"' in body
+            # the overload gauges ride one stat-labeled family
+            assert 'mochi_shed{stat="shed_p"' in body
+            assert 'mochi_shed{stat="sendq_out_bytes"' in body
+            assert 'mochi_shed{stat="sessions.size"' in body
             # every sample line: name{labels} value
             for line in body.splitlines():
                 if line and not line.startswith("#"):
@@ -76,6 +93,7 @@ async def _main():
             for other in replica.config.servers.values():
                 assert other.server_id in body and other.url in body
             assert "Membership" in body and "Store" in body and "Verifier" in body
+            assert "Overload" in body and "shed_p" in body
         finally:
             await admin.close()
 

@@ -1,14 +1,18 @@
 """Admission control (overload shedding) mechanics.
 
-The policy under test (``replica.py``): an event-loop lag monitor drives a
-proportional shed probability; Write1s are shed by a DETERMINISTIC draw
-keyed on (client_id, seed) so every replica sheds the same transactions
-(independent coin flips would collapse the 2f+1 grant quorum); Write2 and
+The policy under test (``server/replica.py`` + ``server/admission.py``): a
+DETERMINISTIC load signal (dispatch pressure, verify occupancy, send-queue
+pressure — all event-counted, never wall-clock) drives a proportional shed
+probability; Write1s are shed by a deterministic draw keyed on (client_id,
+seed) so every replica sheds the same transactions (independent coin flips
+would collapse the 2f+1 grant quorum); shed responses carry a typed
+``OVERLOADED`` + retry-after hint the client's backoff honors; Write2 and
 reads are never shed (admitted work drains); admin ops are never shed; the
-client treats OVERLOADED as flow control (jittered backoff, no refusal
-budget burned) and surfaces hard overload as a typed failure in bounded
-time.  The reference has no admission control (``MochiServer.java:36-54``
-just queues).
+client surfaces hard overload as a typed failure in bounded time.  The
+reference has no admission control (``MochiServer.java:36-54`` just
+queues).  Unlike the retired wall-clock loop-lag signal (OFF since PR 1
+because harness stalls tripped it), admission defaults ON everywhere —
+``test_default_admission_never_sheds_light_load`` pins the no-flake claim.
 """
 
 from __future__ import annotations
@@ -17,16 +21,29 @@ import asyncio
 
 import pytest
 
+from mochi_tpu.client.client import MAX_ALL_SHED_ROUNDS
 from mochi_tpu.client.errors import RequestRefused
 from mochi_tpu.client.txn import TransactionBuilder
 from mochi_tpu.protocol.messages import FailType, RequestFailedFromServer
 from mochi_tpu.testing.virtual_cluster import VirtualCluster
 
 
+def _pin_shed(vc, p: float, retry_after_ms: int = 0) -> None:
+    """Freeze every replica's controller at shed probability ``p`` (the
+    property setter pins it) and, when given, at a fixed retry-after hint
+    (update() is stubbed out so the hint survives the next Write1 batch)."""
+    for r in vc.replicas:
+        r._shed_p = p
+        if retry_after_ms:
+            r._admission.retry_after_ms = retry_after_ms
+            r._admission.update = lambda: None
+
+
 def test_forced_shed_bounces_writes_and_client_fails_fast():
     """With every replica's shed probability pinned to 1.0, writes must be
     shed cluster-wide and the client must fail with a typed RequestRefused
-    quickly (3 all-shed rounds), not burn its whole retry budget."""
+    quickly (MAX_ALL_SHED_ROUNDS all-shed rounds), not burn its whole
+    retry budget."""
 
     async def main():
         async with VirtualCluster(5, rf=4) as vc:
@@ -35,10 +52,7 @@ def test_forced_shed_bounces_writes_and_client_fails_fast():
             await client.execute_write_transaction(
                 TransactionBuilder().write("k", b"v").build()
             )
-            for r in vc.replicas:
-                r._shed_p = 1.0
-                if r._lag_task is not None:  # freeze the controller
-                    r._lag_task.cancel()
+            _pin_shed(vc, 1.0)
             t0 = asyncio.get_event_loop().time()
             with pytest.raises(RequestRefused, match="overloaded"):
                 await client.execute_write_transaction(
@@ -59,6 +73,73 @@ def test_forced_shed_bounces_writes_and_client_fails_fast():
     asyncio.run(main())
 
 
+def test_full_overload_arc_shed_backoff_retry_after_refused():
+    """The whole client arc under hard overload, end to end: Write1s shed
+    with typed OVERLOADED carrying a retry-after hint -> the client's
+    jittered backoff honors the hint (the inter-round wait is at least
+    0.75x the hint, so total elapsed has a hard floor) -> after
+    MAX_ALL_SHED_ROUNDS consecutive fully-shed rounds the client surfaces
+    a typed RequestRefused."""
+
+    async def main():
+        async with VirtualCluster(5, rf=4) as vc:
+            client = vc.client(timeout_s=5.0)
+            await client.execute_write_transaction(
+                TransactionBuilder().write("warm", b"v").build()
+            )
+            hint_ms = 150
+            _pin_shed(vc, 1.0, retry_after_ms=hint_ms)
+            t0 = asyncio.get_event_loop().time()
+            with pytest.raises(RequestRefused, match="overloaded"):
+                await client.execute_write_transaction(
+                    TransactionBuilder().write("k", b"v").build()
+                )
+            elapsed = asyncio.get_event_loop().time() - t0
+            # the raise lands on round MAX_ALL_SHED_ROUNDS, after
+            # (MAX_ALL_SHED_ROUNDS - 1) backoffs of >= 0.75 * hint each
+            floor_s = (MAX_ALL_SHED_ROUNDS - 1) * hint_ms / 1e3 * 0.75
+            assert elapsed >= floor_s, (
+                f"client retried after {elapsed:.3f}s; retry-after hint of "
+                f"{hint_ms}ms demands >= {floor_s:.3f}s — hint not honored"
+            )
+            assert elapsed < 6.0, f"give-up took {elapsed:.1f}s — not bounded"
+            # the shed rounds were counted on the client (flow control, not
+            # refusal budget)
+            assert client.metrics.counters.get("client.write1-shed", 0) >= (
+                MAX_ALL_SHED_ROUNDS
+            )
+            # shed responses really carried the hint on the wire
+            shed_hints = [
+                r._admission.retry_after_ms for r in vc.replicas
+            ]
+            assert all(h == hint_ms for h in shed_hints)
+
+    asyncio.run(main())
+
+
+def test_default_admission_never_sheds_light_load():
+    """Admission control now defaults ON (the deterministic signal).  A
+    light in-process workload — the exact posture that flaked the old
+    wall-clock lag signal into shedding — must never shed: queued work
+    stays far under every high-water mark."""
+
+    async def main():
+        async with VirtualCluster(5, rf=4) as vc:  # no admission override
+            assert all(r._admission.enabled for r in vc.replicas)
+            client = vc.client(timeout_s=5.0)
+            for i in range(8):
+                await client.execute_write_transaction(
+                    TransactionBuilder().write(f"k{i}", b"v").build()
+                )
+            assert all(
+                r.metrics.counters.get("replica.write1-shed", 0) == 0
+                for r in vc.replicas
+            )
+            assert all(r._admission.shed_p == 0.0 for r in vc.replicas)
+
+    asyncio.run(main())
+
+
 def test_partial_shed_retries_through():
     """At a moderate shed probability the client's keyed-draw retries (fresh
     seed = fresh draw) must get the write through without an error."""
@@ -69,10 +150,7 @@ def test_partial_shed_retries_through():
             await client.execute_write_transaction(
                 TransactionBuilder().write("k", b"v").build()
             )
-            for r in vc.replicas:
-                r._shed_p = 0.3
-                if r._lag_task is not None:
-                    r._lag_task.cancel()
+            _pin_shed(vc, 0.3)
             for i in range(6):
                 await client.execute_write_transaction(
                     TransactionBuilder().write(f"p{i}", b"x").build()
@@ -112,9 +190,7 @@ def test_admin_ops_never_shed():
         async with VirtualCluster(5, rf=4) as vc:
             for r in vc.replicas:
                 r.config.admin_keys.append(admin_kp.public_key)
-                r._shed_p = 1.0
-                if r._lag_task is not None:
-                    r._lag_task.cancel()
+            _pin_shed(vc, 1.0)
             client = vc.client(keypair=admin_kp)
             # _CONFIG_ keyspace write = admin op; must commit despite p=1.0
             from mochi_tpu.cluster.config import CONFIG_CLIENT_PREFIX
@@ -124,5 +200,49 @@ def test_admin_ops_never_shed():
                 .write(CONFIG_CLIENT_PREFIX + "ops-client", b"\x01" * 32)
                 .build()
             )
+
+    asyncio.run(main())
+
+
+def test_overloaded_responses_carry_retry_after_on_real_signal():
+    """Un-pinned controller: when the real load signal crosses its
+    high-water mark, shed responses carry a non-zero retry-after hint
+    (the hint is computed from the measured load factor, not a constant)."""
+
+    async def main():
+        async with VirtualCluster(5, rf=4) as vc:
+            client = vc.client(timeout_s=5.0)
+            await client.execute_write_transaction(
+                TransactionBuilder().write("k", b"v").build()
+            )
+            r0 = vc.replicas[0]
+            # drive the signal, not the knob: report verify backlog past
+            # the high-water mark, as a flood of in-flight Write2s would
+            r0._admission.verify_inflight = int(
+                r0._admission.verify_hw * 3
+            )
+            r0._admission.update()
+            assert r0._admission.overloaded
+            assert r0._admission.retry_after_ms > 0
+            assert r0._admission.shed_p > 0.0
+            # and the typed response path forwards it
+            from mochi_tpu.protocol.messages import Write1ToServer
+            from mochi_tpu.protocol import transaction_hash
+
+            txn = TransactionBuilder().write("shedme", b"x").build()
+            blind = client._write1_transaction(txn)
+            # pin the draw under shed_p by flooding attempts: with p ~> 0.5
+            # a handful of seeds guarantees at least one shed
+            r0._shed_p = 1.0
+            env = client._envelope(
+                Write1ToServer(client.client_id, blind, 7, transaction_hash(txn)),
+                "probe-w1",
+                r0.server_id,
+            )
+            resp = await r0.handle_envelope(env)
+            payload = resp.payload
+            assert isinstance(payload, RequestFailedFromServer)
+            assert payload.fail_type == FailType.OVERLOADED
+            assert payload.retry_after_ms > 0
 
     asyncio.run(main())

@@ -28,7 +28,7 @@ def _run(args, timeout):
     )
 
 
-def test_run_all_smoke_covers_all_nine_configs():
+def test_run_all_smoke_covers_all_ten_configs():
     proc = _run(["--smoke"], timeout=480)
     assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-800:]
     recs = [
@@ -37,10 +37,9 @@ def test_run_all_smoke_covers_all_nine_configs():
         if line.startswith("{")
     ]
     by_config = {r.get("config"): r for r in recs}
-    # configs 1-8 plus 10 (byzantine); 9 is reserved for the open-loop
-    # front-end-scale benchmark
+    # configs 1-10: 9 (open-loop overload) joined in round 12
     assert sorted(by_config, key=int) == [
-        str(i) for i in (*range(1, 9), 10)
+        str(i) for i in range(1, 11)
     ], sorted(by_config)
     for key, rec in sorted(by_config.items()):
         assert not rec.get("error"), (key, rec)
