@@ -268,8 +268,10 @@ def run(
     # ROADMAP item 4 remainder: the adversarial strategies whose cost is
     # ALSO measured under packet loss (the clean 13 ms mesh flatters an
     # attacker whose damage compounds with retries) — one extra leg each
-    # at ``loss_drop`` per-frame drop on every link.
-    loss_attacks=("storm", "silent"),
+    # at ``loss_drop`` per-frame drop on every link.  Round 12 covered
+    # storm+silent; round 13 extended the default to the full catalog
+    # (equivocate / forge-cert / stale-replay were clean-mesh-only).
+    loss_attacks=ATTACKS,
     loss_drop: float = 0.02,
     # trim_write1 suspicion-steering A/B (ISSUE 8 satellite): re-measure
     # the off-by-default quorum-trimmed first Write1 attempt now that

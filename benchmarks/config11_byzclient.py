@@ -1,0 +1,415 @@
+"""Config 11: Byzantine clients — what a hostile coordinator costs, priced.
+
+Config 10 priced Byzantine REPLICAS; this config prices Byzantine CLIENTS
+(``testing/byzantine_client.py``) at the same config-7 WAN shape (5-replica
+rf=4 f=1 signed cluster, ``NetSim.mesh(seed=8, rtt_ms=13, jitter_ms=1)``,
+native-C host crypto): one adversarial coordinator with real keys attacks
+the keyspace honest writers are working, once per strategy, with the
+round-13 defenses ON (per-client grant quota + grant-TTL reclamation).
+Three artifacts per attack:
+
+* **honest-writer cost** — read/write p50/p95/p999 and ratios vs the
+  in-run honest leg;
+* **safety verdict** — the InvariantChecker report in-record (now
+  including the reclaimed-slot rule and the wedge liveness metric);
+* **defense evidence** — what the defenses did: reclaim counts, quota
+  refusals, the attacker's own per-replica ledger rows, and what the
+  attacker managed (grants held, partial commits).
+
+**Headline** — time-to-conflicting-commit under ``withhold``: the
+attacker sweeps EVERY subEpoch seed of a key's epoch (the HQ-replication
+contention hole: the epoch only advances on apply, nothing applies, every
+conflicting Write1 is refused at any seed), then an honest writer races to
+commit a conflicting transaction.  With the TTL ON the wedge is bounded
+near the TTL (acceptance: p95 <= 2x ``MOCHI_GRANT_TTL_MS``); with it OFF
+the wedge is unbounded (the probe's deadline floor is recorded).  The
+quota is off in BOTH wedge legs so the probe isolates the TTL defense —
+the quota alone already prevents the full sweep (measured in the
+grant-hoard leg).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Dict, List, Optional
+
+from .config7_wan import JITTER_MS, RTT_MS, SEED, _pcts
+
+CLIENT_ATTACKS = ("withhold", "partial-write2", "seed-bias", "grant-hoard")
+
+
+def _defenses(ttl_ms: float, quota: int):
+    """Pin the round-13 store knobs for one leg (the shared helper from
+    the harness; restores on exit)."""
+    from mochi_tpu.testing.byzantine_client import defense_knobs
+
+    return defense_knobs(ttl_ms=ttl_ms, quota=quota)
+
+
+async def _leg(
+    attack: Optional[str],
+    n_clients: int,
+    keys_per_client: int,
+    sweeps: int,
+    timeout_s: float,
+    ttl_ms: float,
+    quota: int,
+    wedge_seeds: int,
+) -> Dict:
+    """One honest-writer workload leg (config-10 shape), optionally with a
+    Byzantine client attacking the same keys throughout the timed phase."""
+    from mochi_tpu.client.txn import TransactionBuilder
+    from mochi_tpu.netsim import NetSim
+    from mochi_tpu.testing.invariants import InvariantChecker
+    from mochi_tpu.testing.virtual_cluster import VirtualCluster
+    from mochi_tpu.utils.runtime import reset_gc_debt
+
+    sim = NetSim.mesh(seed=SEED, rtt_ms=RTT_MS, jitter_ms=JITTER_MS)
+    with _defenses(ttl_ms, quota):
+        async with VirtualCluster(5, rf=4, netsim=sim) as vc:
+            checker = InvariantChecker(vc.replicas)
+            read_lat: List[float] = []
+            write_lat: List[float] = []
+            write_failures = 0
+            read_failures = 0
+            clients = []
+            all_keys = [
+                f"byzc-{ci}-{k}"
+                for ci in range(n_clients)
+                for k in range(keys_per_client)
+            ]
+
+            async def populate(ci: int):
+                client = vc.client(timeout_s=timeout_s)
+                clients.append(client)
+                for k in range(keys_per_client):
+                    key = f"byzc-{ci}-{k}"
+                    for attempt in range(4):
+                        try:
+                            await client.execute_write_transaction(
+                                TransactionBuilder().write(key, b"seed").build()
+                            )
+                            break
+                        except Exception:
+                            if attempt == 3:
+                                raise
+                    checker.record_ack(key, b"seed")
+
+            await asyncio.gather(*[populate(i) for i in range(n_clients)])
+            reset_gc_debt()
+            checker.start(0.05)
+
+            byz = None
+            byz_task = None
+            if attack:
+                byz = vc.byzantine_client(attack, seed=7, timeout_s=timeout_s)
+                byz_task = asyncio.ensure_future(
+                    byz.run(
+                        all_keys,
+                        duration_s=3600.0,  # cancelled at workload end
+                        interval_s=0.05,
+                        wedge_seeds=wedge_seeds,
+                    )
+                )
+
+            async def worker(ci: int):
+                nonlocal write_failures, read_failures
+                client = clients[ci]
+                for s in range(sweeps):
+                    for k in range(keys_per_client):
+                        key = f"byzc-{ci}-{k}"
+                        val = b"v%d" % s
+                        t0 = time.perf_counter()
+                        try:
+                            await client.execute_write_transaction(
+                                TransactionBuilder().write(key, val).build()
+                            )
+                        except Exception:
+                            # liveness cost, counted honestly; the attack
+                            # CAN refuse individual transactions — safety
+                            # and durability are the checker's department
+                            checker.record_attempt(key, val)
+                            write_failures += 1
+                            continue
+                        write_lat.append(time.perf_counter() - t0)
+                        checker.record_ack(key, val)
+                    for k in range(keys_per_client):
+                        t0 = time.perf_counter()
+                        try:
+                            await client.execute_read_transaction(
+                                TransactionBuilder()
+                                .read(f"byzc-{ci}-{k}")
+                                .build()
+                            )
+                        except Exception:
+                            read_failures += 1
+                            continue
+                        read_lat.append(time.perf_counter() - t0)
+
+            t0 = time.perf_counter()
+            await asyncio.gather(*[worker(i) for i in range(n_clients)])
+            wall = time.perf_counter() - t0
+
+            if byz_task is not None:
+                byz_task.cancel()
+                try:
+                    await byz_task
+                except asyncio.CancelledError:
+                    pass
+
+            await checker.final_check(clients[0])
+            await checker.stop()
+
+            # Defense evidence across the honest stores + the attacker's
+            # own tally of what it managed.
+            reclaims = sum(r.store.reclaims for r in vc.replicas)
+            quota_refused = sum(r.store.quota_refusals for r in vc.replicas)
+            attacker_rows = {}
+            if byz is not None:
+                for r in vc.replicas:
+                    row = (
+                        r.store.client_stats()["per_client"].get(byz.client_id)
+                    )
+                    if row:
+                        attacker_rows[r.server_id] = row
+            return {
+                "attack": attack or "honest",
+                "defenses": {"ttl_ms": ttl_ms, "quota": quota},
+                "read_ms": _pcts(read_lat),
+                "write_ms": _pcts(write_lat),
+                "read_samples": len(read_lat),
+                "write_samples": len(write_lat),
+                "write_failures": write_failures,
+                "read_failures": read_failures,
+                "wall_s": round(wall, 2),
+                "invariants": checker.report(),
+                "evidence": {
+                    "grant_reclaims": reclaims,
+                    "quota_refused": quota_refused,
+                    "attacker_stats": dict(byz.stats) if byz else None,
+                    "attacker_ledger_by_replica": attacker_rows or None,
+                },
+            }
+
+
+async def _wedge_probe(
+    ttl_ms: float,
+    trials: int,
+    timeout_s: float,
+    deadline_s: float,
+    wedge_seeds: int,
+) -> Dict:
+    """The headline measurement: arm the full-seed withhold wedge, then
+    time an honest writer's conflicting commit.  ``ttl_ms=0`` is the
+    pre-round-13 posture — the probe's deadline is the only bound, and a
+    trial that hits it is recorded as wedged (time >= deadline).  Quota is
+    OFF in both postures so the probe isolates the TTL defense."""
+    from mochi_tpu.client.errors import RequestRefused
+    from mochi_tpu.client.txn import TransactionBuilder
+    from mochi_tpu.netsim import NetSim
+    from mochi_tpu.testing.invariants import InvariantChecker
+    from mochi_tpu.testing.virtual_cluster import VirtualCluster
+
+    sim = NetSim.mesh(seed=SEED, rtt_ms=RTT_MS, jitter_ms=JITTER_MS)
+    with _defenses(ttl_ms, 0):
+        async with VirtualCluster(5, rf=4, netsim=sim) as vc:
+            checker = InvariantChecker(vc.replicas)
+            checker.start(0.05)
+            # the attacker tolerates slow sweeps (a 2 s per-RPC budget on a
+            # thousand-message sweep would leave timed-out holes an honest
+            # seed draw slips through — the wedge must be COMPLETE for the
+            # probe to measure the defense, not the attacker's impatience)
+            byz = vc.byzantine_client("withhold", timeout_s=max(timeout_s, 15.0))
+            honest = vc.client(timeout_s=timeout_s, write_attempts=6)
+            times_s: List[float] = []
+            wedged = 0
+            arm_s: List[float] = []
+            held_per_trial: List[int] = []
+            for trial in range(trials):
+                key = f"wedge-{trial}"
+                t_arm = time.perf_counter()
+                held = await byz.wedge(key, seeds=range(wedge_seeds))
+                arm_s.append(time.perf_counter() - t_arm)
+                held_per_trial.append(held)
+                t0 = time.perf_counter()
+                committed = False
+                while time.perf_counter() - t0 < deadline_s:
+                    try:
+                        await honest.execute_write_transaction(
+                            TransactionBuilder().write(key, b"contender").build()
+                        )
+                        committed = True
+                        break
+                    except RequestRefused:
+                        await asyncio.sleep(0.02)
+                    except Exception:
+                        # a tenancy-stalled timeout or a reclaim-race
+                        # tally split is a failed ATTEMPT, not a reason
+                        # to abort the whole config's record
+                        await asyncio.sleep(0.05)
+                elapsed = time.perf_counter() - t0
+                times_s.append(elapsed)
+                if committed:
+                    checker.record_ack(key, b"contender")
+                else:
+                    wedged += 1
+            await checker.final_check(honest)
+            await checker.stop()
+            reclaims = sum(r.store.reclaims for r in vc.replicas)
+            pcts = _pcts(times_s)
+            return {
+                "ttl_ms": ttl_ms,
+                "quota": 0,
+                "trials": trials,
+                "wedge_seeds": wedge_seeds,
+                "probe_deadline_s": deadline_s,
+                "wedge_arm_s": [round(a, 2) for a in arm_s],
+                # 4 in-set replicas x wedge_seeds when the sweep is
+                # complete; a shortfall means timed-out holes the honest
+                # writer can slip through — read the leg accordingly
+                "slots_held_per_trial": held_per_trial,
+                "time_to_conflicting_commit_ms": pcts,
+                "samples_ms": [round(t * 1e3, 1) for t in times_s],
+                "trials_wedged_past_deadline": wedged,
+                "grant_reclaims": reclaims,
+                "invariants": checker.report(),
+            }
+
+
+def run(
+    n_clients: int = 3,
+    keys_per_client: int = 10,
+    sweeps: int = 3,
+    attacks=CLIENT_ATTACKS,
+    timeout_s: float = 2.0,
+    # defenses-on posture for the attack-cost legs: the quota default and
+    # a leg-scale TTL (the production default is 5 s; a WAN leg lasting
+    # ~30-60 s wants reclaim activity visible within it)
+    ttl_ms: float = 1000.0,
+    quota: int = 128,
+    wedge_seeds_cost: int = 192,
+    # the headline wedge duel: full-seed sweep, quota off, TTL on vs off
+    wedge_trials: int = 3,
+    wedge_ttl_ms: float = 3000.0,
+    wedge_deadline_s: float = 8.0,
+    wedge_seeds: int = 1000,
+) -> Dict:
+    from mochi_tpu.net import transport
+    from mochi_tpu.utils.runtime import tune_gc_for_server
+
+    tune_gc_for_server()
+    prev_floor = transport.RTT_FLOOR_S
+    transport.RTT_FLOOR_S = max(prev_floor, RTT_MS / 1e3)
+
+    def _vs_honest(leg: Dict, honest: Dict) -> Dict:
+        return {
+            "write_p50_ratio": _ratio(
+                leg["write_ms"]["p50"], honest["write_ms"]["p50"]
+            ),
+            "write_p95_ratio": _ratio(
+                leg["write_ms"]["p95"], honest["write_ms"]["p95"]
+            ),
+            "read_p50_ratio": _ratio(
+                leg["read_ms"]["p50"], honest["read_ms"]["p50"]
+            ),
+            "read_p95_ratio": _ratio(
+                leg["read_ms"]["p95"], honest["read_ms"]["p95"]
+            ),
+        }
+
+    try:
+        honest = asyncio.run(
+            _leg(
+                None, n_clients, keys_per_client, sweeps, timeout_s,
+                ttl_ms, quota, wedge_seeds_cost,
+            )
+        )
+        per_attack: Dict[str, Dict] = {}
+        for attack in attacks:
+            leg = asyncio.run(
+                _leg(
+                    attack, n_clients, keys_per_client, sweeps, timeout_s,
+                    ttl_ms, quota, wedge_seeds_cost,
+                )
+            )
+            leg["vs_honest"] = _vs_honest(leg, honest)
+            per_attack[attack] = leg
+        wedge_on = asyncio.run(
+            _wedge_probe(
+                wedge_ttl_ms, wedge_trials, timeout_s, wedge_deadline_s,
+                wedge_seeds,
+            )
+        )
+        wedge_off = asyncio.run(
+            _wedge_probe(
+                0.0, max(1, wedge_trials - 1), timeout_s, wedge_deadline_s,
+                wedge_seeds,
+            )
+        )
+    finally:
+        transport.RTT_FLOOR_S = prev_floor
+
+    all_safe = honest["invariants"]["ok"] and all(
+        leg["invariants"]["ok"] for leg in per_attack.values()
+    ) and wedge_on["invariants"]["ok"] and wedge_off["invariants"]["ok"]
+    p95_ms = wedge_on["time_to_conflicting_commit_ms"]["p95"]
+    bounded = bool(p95_ms == p95_ms and p95_ms <= 2 * wedge_ttl_ms)
+    unbounded_off = (
+        wedge_off["trials_wedged_past_deadline"] == wedge_off["trials"]
+    )
+    return {
+        "metric": "byzantine_client_wedge_bound_ms",
+        # Headline: how long a full-seed withholding wedge can block a
+        # conflicting honest commit WITH reclamation on (p95) — off, the
+        # probe only ever observes its own deadline.
+        "value": p95_ms,
+        "unit": (
+            "ms to conflicting commit under withhold (p95, TTL "
+            f"{wedge_ttl_ms:g} ms; TTL off = unbounded)"
+        ),
+        "safety_invariants_hold_under_all_attacks": all_safe,
+        "acceptance": {
+            "ttl_on_p95_bounded_2x_ttl": bounded,
+            "ttl_off_unbounded_at_probe_deadline": unbounded_off,
+        },
+        "topology": {
+            "replicas": 5,
+            "rf": 4,
+            "f": 1,
+            "clients": n_clients,
+            "byzantine_clients": 1,
+            "keys_per_client": keys_per_client,
+            "sweeps": sweeps,
+            "client_timeout_s": timeout_s,
+            "mesh_rtt_ms": RTT_MS,
+            "mesh_jitter_ms": JITTER_MS,
+            "netsim_seed": SEED,
+        },
+        "defenses": {"ttl_ms": ttl_ms, "quota": quota},
+        "honest": honest,
+        "attacks": per_attack,
+        "wedge_ttl_on": wedge_on,
+        "wedge_ttl_off": wedge_off,
+        "notes": (
+            "attack legs run the round-13 defenses ON (quota + TTL "
+            "reclamation); the wedge duel runs quota OFF in both legs to "
+            "isolate the TTL bound — the quota alone already caps the "
+            "full-seed sweep (grant-hoard leg evidence).  invariants.ok="
+            "false in ANY leg is a safety failure, not a latency "
+            "regression; every leg's report carries the reclaimed-slot "
+            "invariant and the max-wedge liveness metric."
+        ),
+    }
+
+
+def _ratio(a: float, b: float) -> Optional[float]:
+    if not a or not b or a != a or b != b:  # NaN-safe (empty sample sets)
+        return None
+    return round(a / b, 4)
+
+
+if __name__ == "__main__":
+    import json
+
+    print(json.dumps(run(), indent=2))

@@ -32,6 +32,7 @@ CONFIG_NAMES = {
     "8": "config8_scaleout",
     "9": "config9_overload",
     "10": "config10_byzantine",
+    "11": "config11_byzclient",
 }
 
 # --smoke: tiny-count kwargs per config — a seconds-scale pass whose only
@@ -72,6 +73,15 @@ SMOKE_KWARGS = {
     "10": dict(
         n_clients=1, keys_per_client=2, sweeps=1, attacks=("silent",),
         timeout_s=1.0, loss_attacks=(), trim_ab=False,
+    ),
+    # one honest + one byzantine-CLIENT leg + a tiny wedge duel: the whole
+    # config-11 harness surface (ByzantineClient driver, defense knobs,
+    # wedge probe, record schema) in seconds — a 24-seed sweep can't
+    # actually wedge, so the probe numbers are noise by construction
+    "11": dict(
+        n_clients=1, keys_per_client=2, sweeps=1, attacks=("withhold",),
+        timeout_s=1.0, ttl_ms=300.0, wedge_trials=1, wedge_ttl_ms=300.0,
+        wedge_deadline_s=2.0, wedge_seeds=24, wedge_seeds_cost=16,
     ),
 }
 

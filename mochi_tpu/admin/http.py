@@ -57,6 +57,8 @@ quorum={quorum} &middot; {member}</p>
 <table>{fanout_rows}</table>
 <h2>Byzantine evidence</h2>
 <table>{byzantine_rows}</table>
+<h2>Clients</h2>
+<table>{clients_rows}</table>
 <p class="muted">{sessions} live client sessions &middot;
 admin-gated: {admin_gated} &middot; page auto-refreshes</p>
 <ul>
@@ -272,6 +274,54 @@ def _byzantine_prom(replica) -> str:
     return "# TYPE mochi_byzantine gauge\n" + "".join(lines)
 
 
+def _clients_rows(replica) -> str:
+    """The "/" page Clients table: grant/quota/reclaim accounting — the
+    aggregate knobs and wedge liveness metric first, then one row per
+    tracked client identity (replica.client_grant_stats; docs/OPERATIONS.md
+    §4h)."""
+    st = replica.client_grant_stats()
+    rows = []
+    for k in (
+        "quota", "ttl_ms", "reclaims", "quota_refused", "outstanding_total",
+        "max_wedge_ms", "open_wedges",
+    ):
+        rows.append(f"<tr><td>{_esc(k)}</td><td>{_esc(st[k])}</td></tr>")
+    per_client = st.get("per_client", {})
+    if not per_client:
+        rows.append(
+            "<tr><td>(no per-client grant traffic yet)</td><td></td></tr>"
+        )
+    for cid, cst in sorted(per_client.items()):
+        parts = " ".join(f"{k}={v}" for k, v in sorted(cst.items()))
+        rows.append(f"<tr><td>{_esc(cid)}</td><td>{_esc(parts)}</td></tr>")
+    return "".join(rows)
+
+
+def _clients_prom(replica) -> str:
+    """``mochi_client{client,stat}`` exposition: aggregate rows carry
+    ``client=""``; per-identity rows track the store's client-stats
+    table — FIFO-capped at CLIENT_STATS_MAX, with live grant holders
+    admitted over cap until their grants age out (so an identity flood's
+    series count is bounded by cap + flood-rate x TTL, not by cap alone;
+    see DataStore._client_entry)."""
+    st = replica.client_grant_stats()
+    sid = _prom_esc(replica.server_id)
+    lines = ["# TYPE mochi_client gauge\n"]
+    flat: list = []
+    _walk_numeric("", {k: v for k, v in st.items() if k != "per_client"}, flat)
+    for k, v in flat:
+        lines.append(
+            f'mochi_client{{client="",stat="{k}",server="{sid}"}} {v}\n'
+        )
+    for cid, cst in sorted(st.get("per_client", {}).items()):
+        cn = _prom_esc(cid)
+        for k, v in sorted(cst.items()):
+            lines.append(
+                f'mochi_client{{client="{cn}",stat="{k}",server="{sid}"}} {v}\n'
+            )
+    return "".join(lines)
+
+
 def _overload_rows(replica) -> str:
     """The "/" page Overload table: admission-control state and bounded-
     table sizes, flattened to one row per numeric leaf."""
@@ -415,6 +465,11 @@ class AdminServer(HttpJsonServer):
                     # (conflicting validly-signed grants for one slot) and
                     # bad-grant attribution (docs/OPERATIONS.md §4f)
                     "byzantine": r.byzantine_stats(),
+                    # per-client grant/quota/reclaim accounting + the wedge
+                    # liveness metric (docs/OPERATIONS.md §4h): who holds
+                    # outstanding grants, who keeps getting reclaimed
+                    # (withholders), who bounces off the quota (hoarders)
+                    "clients": r.client_grant_stats(),
                     "config_history_stamps": sorted(r.store.config_history),
                     "member": r.server_id in cfg.servers,
                     "admin_gated": bool(cfg.admin_keys),
@@ -457,6 +512,9 @@ class AdminServer(HttpJsonServer):
                 )
             body += _fanout_prom(r.metrics, "server", r.server_id)
             body += _byzantine_prom(r)
+            # Per-client grant accounting: mochi_client{client,stat} —
+            # "is any client hoarding or being reclaimed?" is one query.
+            body += _clients_prom(r)
             # Overload/admission gauges as one stat-labeled family:
             # mochi_shed{stat="shed_p"|"load"|"sendq_out_bytes"|
             # "sessions.size"|...} — "is any replica shedding, and why?"
@@ -519,6 +577,7 @@ class AdminServer(HttpJsonServer):
                 overload_rows=_overload_rows(r),
                 fanout_rows=_fanout_rows(r.metrics),
                 byzantine_rows=_byzantine_rows(r),
+                clients_rows=_clients_rows(r),
                 sessions=len(getattr(r, "_sessions", {})),
                 admin_gated=bool(cfg.admin_keys),
             )
@@ -542,10 +601,47 @@ _CLIENT_PAGE = """<!doctype html>
 {early_quorum} &middot; {sessions} live sessions</p>
 <h2>Fan-out</h2>
 <table>{fanout_rows}</table>
+<h2>Clients</h2>
+<table>{clients_rows}</table>
 <h2>Timers</h2>
 <table>{timer_rows}</table>
 </body></html>
 """
+
+
+def _client_grant_view(client) -> dict:
+    """The INITIATOR's own grant/quota view (the client-shell half of the
+    round-13 Clients surface): how often THIS identity bounced off each
+    replica's grant quota — the self-diagnosis row an operator reads when
+    a client's writes start backing off ("am I the hoarder?")."""
+    prefix = "client.quota-refused."
+    per_replica = {
+        name[len(prefix):]: n
+        for name, n in client.metrics.counters.items()
+        if name.startswith(prefix)
+    }
+    return {
+        "quota_refusals": client.metrics.counters.get("client.write1-quota", 0),
+        "shed_rounds": client.metrics.counters.get("client.write1-shed", 0),
+        "per_replica_quota_refused": per_replica,
+    }
+
+
+def _client_grant_rows(client) -> str:
+    st = _client_grant_view(client)
+    rows = [
+        f"<tr><td>quota_refusals</td><td>{st['quota_refusals']}</td></tr>",
+        f"<tr><td>shed_rounds</td><td>{st['shed_rounds']}</td></tr>",
+    ]
+    for sid, n in sorted(st["per_replica_quota_refused"].items()):
+        rows.append(
+            f"<tr><td>{_esc(sid)}</td><td>quota_refused={n}</td></tr>"
+        )
+    if len(rows) == 2 and not st["per_replica_quota_refused"]:
+        rows.append(
+            "<tr><td>(no quota refusals seen)</td><td></td></tr>"
+        )
+    return "".join(rows)
 
 
 class ClientAdminServer(HttpJsonServer):
@@ -573,6 +669,8 @@ class ClientAdminServer(HttpJsonServer):
                     # per-peer tally-path suspicion breakdown (the fanout
                     # peers table carries the same data as suspect_* rows)
                     "suspicion": c.suspicion_stats(),
+                    # this identity's own grant-quota view (round 13)
+                    "clients": _client_grant_view(c),
                     "timers": {
                         name: t.snapshot() for name, t in sorted(m.timers.items())
                     },
@@ -595,6 +693,7 @@ class ClientAdminServer(HttpJsonServer):
                 early_quorum="on" if c.early_quorum else "off",
                 sessions=len(c._sessions),
                 fanout_rows=_fanout_rows(m),
+                clients_rows=_client_grant_rows(c),
                 timer_rows=timer_rows or "<tr><td>(no traffic)</td><td></td></tr>",
             )
         return 404, "application/json", json.dumps({"error": "not found"})

@@ -1039,6 +1039,24 @@ class MochiDBClient:
                         if isinstance(p, RequestFailedFromServer)
                         and p.fail_type == FailType.OVERLOADED
                     )
+                    # Per-client grant-quota refusals (round 13) ride the
+                    # same flow-control contract as sheds: typed, carry a
+                    # retry-after hint, and resolve by backing off (the
+                    # client's own earlier grants commit or age out) — but
+                    # they are counted apart, per replica, because for an
+                    # operator "my cluster is overloaded" and "this client
+                    # is hoarding grants" are different diagnoses (the
+                    # bounded escalation below says which one happened).
+                    quota_refused = 0
+                    for sid, p in responses.items():
+                        if (
+                            isinstance(p, RequestFailedFromServer)
+                            and p.fail_type == FailType.QUOTA_EXCEEDED
+                        ):
+                            quota_refused += 1
+                            self.metrics.mark("client.write1-quota")
+                            self.metrics.mark(f"client.quota-refused.{sid}")
+                    shed += quota_refused
                     if shed:
                         # Admission control turned us away — this is flow
                         # control, not refusal: exponential jittered backoff
@@ -1054,6 +1072,19 @@ class MochiDBClient:
                         if shed >= len(responses) and len(responses) > 0:
                             all_shed_rounds += 1
                             if all_shed_rounds >= MAX_ALL_SHED_ROUNDS:
+                                if quota_refused == shed:
+                                    # quota-only rounds: the cluster is
+                                    # fine — THIS identity is over its
+                                    # grant budget (hoarding, or wide
+                                    # transactions piling up abandoned
+                                    # grants); the overload runbook is
+                                    # the wrong place to send anyone
+                                    raise RequestRefused(
+                                        "per-client grant quota exhausted: "
+                                        f"write refused {all_shed_rounds}x "
+                                        "(outstanding grants must commit "
+                                        "or age out)"
+                                    )
                                 raise RequestRefused(
                                     "cluster overloaded: write shed by "
                                     f"admission control {all_shed_rounds}x"
@@ -1074,7 +1105,8 @@ class MochiDBClient:
                                 p.retry_after_ms
                                 for p in responses.values()
                                 if isinstance(p, RequestFailedFromServer)
-                                and p.fail_type == FailType.OVERLOADED
+                                and p.fail_type
+                                in (FailType.OVERLOADED, FailType.QUOTA_EXCEEDED)
                             ),
                             default=0,
                         )

@@ -69,6 +69,12 @@ class FailType(IntEnum):
     BAD_CERTIFICATE = 2  # new: write certificate failed quorum/signature checks
     BAD_REQUEST = 3  # new: request failed input validation (e.g. seed range)
     OVERLOADED = 4  # new: admission control shed this request; retry with backoff
+    # new: the sender's per-client outstanding-grant quota is exhausted
+    # (server/store.py CLIENT_GRANT_QUOTA) — flow control against grant
+    # hoarding, carried with a retry-after hint like OVERLOADED; an honest
+    # client only sees it while its own earlier grants are still pending
+    # commit/GC, so backing off and retrying is always the right response.
+    QUOTA_EXCEEDED = 5
 
 
 # Decode-path enum lookup: Enum.__call__ is ~3x a dict hit and these run on

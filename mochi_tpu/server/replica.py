@@ -59,7 +59,7 @@ from ..protocol import (
 from ..utils.metrics import Metrics
 from ..verifier.spi import CpuVerifier, SignatureVerifier, VerifyItem
 from .admission import AdmissionController, SessionTable, TokenBucket
-from .store import BadRequest, DataStore
+from .store import BadRequest, DataStore, QuotaExceeded
 
 LOG = logging.getLogger(__name__)
 
@@ -1005,6 +1005,22 @@ class MochiReplica:
                 results = self.store.process_write1_batch(reqs)
             for i, env, result in zip(req_idx, (envs[j] for j in req_idx), results):
                 try:
+                    if isinstance(result, QuotaExceeded):
+                        # Per-client grant quota (round 13): typed refusal
+                        # with a retry-after hint, riding the same client
+                        # backoff contract as OVERLOADED sheds — and a
+                        # replica-side suspicion observable (the store's
+                        # per-client ledger already counted it).
+                        metrics.mark("replica.write1-quota-refused")
+                        out[i] = self._respond(
+                            env,
+                            RequestFailedFromServer(
+                                FailType.QUOTA_EXCEEDED,
+                                str(result),
+                                result.retry_after_ms,
+                            ),
+                        )
+                        continue
                     if isinstance(result, BadRequest):
                         out[i] = self._respond(
                             env,
@@ -1272,6 +1288,18 @@ class MochiReplica:
                         "EQUIVOCATION by %s: object %r ts=%d granted to two "
                         "transactions", mg.server_id, g.object_id, g.timestamp,
                     )
+
+    def client_grant_stats(self) -> Dict[str, object]:
+        """Per-client grant/quota/reclaim accounting for the admin surfaces
+        (/status "clients", ``mochi_client`` prom family, "/" Clients
+        table): the replica-side mirror of the client SDK's per-peer
+        suspicion ledger — reclaimed_from marks withholders, quota_refused
+        marks hoarders (docs/OPERATIONS.md §4h)."""
+        st = self.store.client_stats()
+        st["quota_refusals_served"] = self.metrics.counters.get(
+            "replica.write1-quota-refused", 0
+        )
+        return st
 
     def byzantine_stats(self) -> Dict[str, object]:
         """Per-peer misbehavior evidence for the admin surfaces (/status

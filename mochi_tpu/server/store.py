@@ -31,6 +31,8 @@ Protocol semantics preserved (with reference cites):
 from __future__ import annotations
 
 import logging
+import os
+import time
 from dataclasses import dataclass, field as dc_field
 from typing import Dict, Iterable, List, Optional, Tuple, Union
 
@@ -73,6 +75,58 @@ EPOCH_UNIT = 1000
 # (ref: StoreValueObjectContainer.java:158-169).
 GRANT_GC_EPOCHS = 2 * EPOCH_UNIT
 
+# ---------------------------------------------------------------------------
+# Byzantine-client defenses (docs/OPERATIONS.md §4h).  The reference — and
+# HQ replication, whose contention/cleanup weakness the paper inherits —
+# has NO grant expiry: a client that collects grants and never sends Write2
+# parks the slot forever, and one that sweeps every subEpoch seed of a
+# key's current epoch wedges all conflicting writers indefinitely (the
+# epoch only advances on apply, and nothing ever applies).  Two knobs:
+#
+# * MOCHI_GRANT_TTL_MS — uncommitted-grant reclamation age (0 = off).  A
+#   conflicting Write1 that collides with a grant older than the effective
+#   TTL SUPERSEDES it: the stale grant is dropped, the key's epoch is
+#   bumped past the contested slot, and the new transaction is granted at
+#   a strictly HIGHER timestamp (see process_write1 for the safety
+#   argument).  The effective TTL is floored at 8x MOCHI_RTT_FLOOR_MS so
+#   WAN postures never reclaim a merely-slow live client mid-Write2 (the
+#   whole honest write path spans ~2 RTT plus retries).
+# * MOCHI_CLIENT_GRANT_QUOTA — outstanding OK grants one client identity
+#   may hold across this replica's whole keyspace (0 = off).  Past it,
+#   Write1 gets a typed QUOTA_EXCEEDED refusal with a retry-after hint
+#   (the PR-8 admission plumbing), so grant-hoarding sweeps are capped at
+#   quota slots instead of the full seed space.  Config-keyspace-only
+#   transactions are exempt: an operator reconfiguring an attacked
+#   cluster must get through (same posture as shed exemption for admin
+#   ops).
+GRANT_TTL_MS = float(os.environ.get("MOCHI_GRANT_TTL_MS", "5000"))
+CLIENT_GRANT_QUOTA = int(os.environ.get("MOCHI_CLIENT_GRANT_QUOTA", "128"))
+# Bounded evidence/bookkeeping: per-client stat entries and reclaimed-slot
+# ledger age out FIFO (entries with outstanding grants are never evicted —
+# the quota must not be evadable by stat-table churn).
+CLIENT_STATS_MAX = 1024
+RECLAIM_LEDGER_MAX = 4096
+WEDGE_TABLE_MAX = 4096
+
+
+def effective_grant_ttl_ms() -> float:
+    """The reclaim age actually enforced: ``GRANT_TTL_MS`` floored at
+    8x the transport's RTT floor.  On a conditioned WAN (config 7/10/11
+    set ``MOCHI_RTT_FLOOR_MS`` to the mesh RTT) a live-but-slow honest
+    client's Write1->Write2 window is ~2 RTT plus the retry ladder;
+    reclaiming inside that window would turn ordinary slowness into
+    contention churn, so the floor keeps the TTL comfortably outside it.
+    0 = reclamation off (the pre-round-13 behavior)."""
+    if GRANT_TTL_MS <= 0:
+        return 0.0
+    try:
+        from ..net import transport
+
+        floor_ms = transport.RTT_FLOOR_S * 1e3
+    except Exception:  # pragma: no cover - transport always importable
+        floor_ms = 0.0
+    return max(GRANT_TTL_MS, 8.0 * floor_ms)
+
 
 @dataclass
 class StoreValue:
@@ -110,16 +164,21 @@ class StoreValue:
             if not bucket:
                 del self.grants[epoch]
 
-    def advance_epoch(self, applied_ts: int) -> None:
+    def advance_epoch(self, applied_ts: int) -> List[int]:
         """Move past the applied timestamp's epoch and GC stale grant epochs
         (ref: ``moveToNextEpochIfNecessary``, SVOC.java:83-88 — plus the GC the
-        reference never wired up, SVOC.java:158-169)."""
+        reference never wired up, SVOC.java:158-169).  Returns the GC'd
+        grant timestamps so the store can release their per-client
+        quota/ownership bookkeeping (round 13)."""
         nxt = self.epoch_of(applied_ts) + EPOCH_UNIT
         if nxt > self.current_epoch:
             self.current_epoch = nxt
         horizon = self.current_epoch - GRANT_GC_EPOCHS
+        dropped: List[int] = []
         for epoch in [e for e in self.grants if e < horizon]:
+            dropped.extend(self.grants[epoch])
             del self.grants[epoch]
+        return dropped
 
     def certificate_timestamp(self, replica_set: Optional[set] = None) -> Optional[int]:
         """Timestamp certified for this key by the current certificate
@@ -208,6 +267,45 @@ class DataStore:
             "write2_applied": 0,
             "write2_foreign": 0,
         }
+        # ---- Byzantine-client accounting (round 13; docs/OPERATIONS.md §4h)
+        # (key, ts) -> (client_id, issued_monotonic) for every OUTSTANDING
+        # OK grant: the issue tick the reclaim rule ages against, and the
+        # ownership record the per-client quota counts.  Size is bounded by
+        # the grant books themselves (GC horizon per key) plus the quota.
+        self._grant_meta: Dict[Tuple[str, int], Tuple[str, float]] = {}
+        # client_id -> {(key, ts), ...} inverse index over _grant_meta: the
+        # expiry sweep and the quota's already-held credit must be O(that
+        # client's grants), never a scan of the global table (an attacker
+        # sitting at quota would otherwise buy a full-table scan per
+        # refused Write1).
+        self._client_slots: Dict[str, set] = {}
+        # client_id -> {"outstanding", "issued", "reclaimed_from",
+        # "quota_refused"} — the replica-side per-client suspicion ledger
+        # (mirrors the client SDK's per-peer suspicion counters): a client
+        # whose grants keep getting reclaimed is a withholder; one bouncing
+        # off the quota is a hoarder.  FIFO-bounded; entries still holding
+        # outstanding grants are never evicted (quota must not be evadable
+        # by churning the stat table).
+        self.client_stats_map: Dict[str, Dict[str, int]] = {}
+        # (key, ts) -> transaction hash the reclaimed grant was issued to:
+        # the slot ledger the InvariantChecker audits — a COMMITTED
+        # certificate at a reclaimed slot must carry exactly this hash
+        # (the original grantee's; see the safety argument in
+        # process_write1).  FIFO-bounded evidence.
+        self.reclaimed: Dict[Tuple[str, int], bytes] = {}
+        self.reclaims = 0
+        self.quota_refusals = 0
+        # Liveness metric: per-key wedge clock, key -> (opened_monotonic,
+        # refused client).  A conflict refusal opens the key's wedge
+        # window; it closes when THAT writer obtains a grant (per-writer:
+        # the attacker re-acquiring slots must not truncate an honest
+        # writer's window) or when any commit applies.  The max closed
+        # window is the published "max wedge time" — with reclamation on
+        # it is bounded near the TTL; without it a withholding client
+        # keeps windows open indefinitely (visible as open_wedges +
+        # max_open_wedge_ms on the admin surfaces).
+        self._wedge_start: Dict[str, Tuple[float, str]] = {}
+        self.max_wedge_ms = 0.0
 
     def shard_stats(self) -> Dict[str, int]:
         """Token-ring ownership summary + per-phase owned/foreign counters.
@@ -228,6 +326,161 @@ class DataStore:
             "tokens_primary": primary,
             "tokens_in_replica_set": in_set,
             **self.shard_counters,
+        }
+
+    # ------------------------------------------ per-client grant accounting
+
+    def _client_entry(self, client_id: str) -> Dict[str, int]:
+        entry = self.client_stats_map.get(client_id)
+        if entry is None:
+            if len(self.client_stats_map) >= CLIENT_STATS_MAX:
+                # FIFO-evict the first entry holding no outstanding
+                # grants; failing that, expire the OLDEST entry's aged
+                # grants and evict it if that freed it.  A table full of
+                # genuinely-live holders still admits over cap rather
+                # than forget a quota obligation (same posture as the
+                # session table's pins), so under an identity flood the
+                # bound is cap + (flood rate x TTL) — each over-cap
+                # entry's single grant ages out within one TTL and the
+                # entry becomes evictable (registry-gated clusters bound
+                # identities outright; open-mode Sybil hardening is the
+                # ROADMAP's remaining frontier).
+                victim = None
+                for cid, st in self.client_stats_map.items():
+                    if st["outstanding"] <= 0:
+                        victim = cid
+                        break
+                if victim is None:
+                    oldest = next(iter(self.client_stats_map))
+                    self._sweep_expired_grants(oldest, time.monotonic())
+                    if self.client_stats_map[oldest]["outstanding"] <= 0:
+                        victim = oldest
+                if victim is not None:
+                    del self.client_stats_map[victim]
+            entry = {
+                "outstanding": 0,
+                "issued": 0,
+                "reclaimed_from": 0,
+                "quota_refused": 0,
+            }
+            self.client_stats_map[client_id] = entry
+        return entry
+
+    def _track_grant(self, key: str, ts: int, client_id: str, now: float) -> None:
+        self._grant_meta[(key, ts)] = (client_id, now)
+        self._client_slots.setdefault(client_id, set()).add((key, ts))
+        entry = self._client_entry(client_id)
+        entry["outstanding"] += 1
+        entry["issued"] += 1
+
+    def _untrack_grant(self, key: str, ts: int) -> Optional[Tuple[str, float]]:
+        meta = self._grant_meta.pop((key, ts), None)
+        if meta is not None:
+            slots = self._client_slots.get(meta[0])
+            if slots is not None:
+                slots.discard((key, ts))
+                if not slots:
+                    del self._client_slots[meta[0]]
+            entry = self.client_stats_map.get(meta[0])
+            if entry is not None and entry["outstanding"] > 0:
+                entry["outstanding"] -= 1
+        return meta
+
+    def _reclaim_slot(self, sv: StoreValue, key: str, ts: int) -> None:
+        """Withdraw one aged uncommitted grant — shared by the
+        conflict-path reclaim and the quota-pressure expiry sweep: ledger
+        the slot (InvariantChecker audit trail), drop the grant, release
+        its quota, and bump the key's epoch past the slot so it can never
+        be re-granted (the safety argument on :meth:`process_write1`)."""
+        existing = sv.grant_at(ts)
+        if existing is not None:
+            if len(self.reclaimed) >= RECLAIM_LEDGER_MAX:
+                self.reclaimed.pop(next(iter(self.reclaimed)))
+            self.reclaimed[(key, ts)] = existing.transaction_hash
+        self.reclaims += 1
+        meta = self._untrack_grant(key, ts)
+        if meta is not None:
+            owner = self.client_stats_map.get(meta[0])
+            if owner is not None:
+                owner["reclaimed_from"] += 1
+        sv.delete_grant(ts)
+        for dts in sv.advance_epoch(ts):
+            self._untrack_grant(key, dts)
+
+    def _sweep_expired_grants(self, client_id: str, now: float) -> int:
+        """Expiry sweep for ONE client's aged grants, run when its quota
+        would otherwise refuse (amortized: quota pressure pays for the
+        scan, and the per-client slot index keeps it O(that client's
+        grants) — never a global-table scan an at-quota attacker could
+        buy per refused request).  Without it, an honest client's
+        abandoned grants — partial OK rounds from retried contention,
+        grants on keys no writer ever touches again — would pin its
+        quota forever: reclamation is otherwise conflict-triggered, and
+        nothing conflicts with an abandoned slot.  Each swept slot goes
+        through the full reclaim (ledger + epoch bump), so the safety
+        argument is unchanged."""
+        ttl_ms = effective_grant_ttl_ms()
+        if ttl_ms <= 0:
+            return 0
+        swept = 0
+        for key, ts in list(self._client_slots.get(client_id, ())):
+            meta = self._grant_meta.get((key, ts))
+            if meta is None or (now - meta[1]) * 1e3 < ttl_ms:
+                continue
+            sv = self._get(key)
+            if sv is None:  # key vanished (snapshot load edge): just untrack
+                self._untrack_grant(key, ts)
+                continue
+            self._reclaim_slot(sv, key, ts)
+            swept += 1
+        return swept
+
+    def _wedge_open(self, key: str, client_id: str, now: float) -> None:
+        if key not in self._wedge_start and len(self._wedge_start) < WEDGE_TABLE_MAX:
+            self._wedge_start[key] = (now, client_id)
+
+    def _wedge_close(self, key: str, now: float, client_id: Optional[str] = None) -> None:
+        """Close the key's wedge window.  Per-WRITER when ``client_id`` is
+        given (grant issuance): only the refused writer obtaining a grant
+        ends its own wait — the wedging attacker re-acquiring slots on
+        the key must not truncate the honest writer's window into short
+        segments that flatter the published max.  A commit
+        (``client_id=None``) closes unconditionally: the key made
+        progress for everyone."""
+        entry = self._wedge_start.get(key)
+        if entry is None:
+            return
+        if client_id is not None and entry[1] != client_id:
+            return
+        del self._wedge_start[key]
+        wedge_ms = (now - entry[0]) * 1e3
+        if wedge_ms > self.max_wedge_ms:
+            self.max_wedge_ms = wedge_ms
+
+    def client_stats(self) -> Dict[str, object]:
+        """Per-client grant/quota/reclaim accounting for the admin surfaces
+        (/status "clients", ``mochi_client`` prom family, "/" Clients
+        table — docs/OPERATIONS.md §4h)."""
+        now = time.monotonic()
+        open_ms = (
+            (now - min(v[0] for v in self._wedge_start.values())) * 1e3
+            if self._wedge_start
+            else 0.0
+        )
+        return {
+            "quota": CLIENT_GRANT_QUOTA,
+            "ttl_ms": round(effective_grant_ttl_ms(), 1),
+            "reclaims": self.reclaims,
+            "quota_refused": self.quota_refusals,
+            "outstanding_total": len(self._grant_meta),
+            "tracked_clients": len(self.client_stats_map),
+            "reclaimed_slots": len(self.reclaimed),
+            "max_wedge_ms": round(self.max_wedge_ms, 2),
+            "open_wedges": len(self._wedge_start),
+            "max_open_wedge_ms": round(open_ms, 2),
+            "per_client": {
+                cid: dict(st) for cid, st in self.client_stats_map.items()
+            },
         }
 
     # ------------------------------------------------------------------ util
@@ -338,11 +591,112 @@ class DataStore:
 
     def process_write1(self, req: Write1ToServer) -> Write1Response:
         """Issue (or refuse) grants for every key in the transaction
-        (ref: ``tryProcessWriteRegularly``, ``InMemoryDataStore.java:233-310``)."""
+        (ref: ``tryProcessWriteRegularly``, ``InMemoryDataStore.java:233-310``).
+
+        Round-13 defenses on this path (docs/OPERATIONS.md §4h):
+
+        * **Per-client quota** — a client already holding
+          ``CLIENT_GRANT_QUOTA`` outstanding OK grants gets a typed
+          ``QuotaExceeded`` (the replica maps it to
+          ``FailType.QUOTA_EXCEEDED`` + retry-after) before any grant is
+          issued, capping grant-hoarding sweeps at quota slots.
+
+        * **Reclamation** — a conflicting Write1 colliding with an
+          UNCOMMITTED grant older than ``effective_grant_ttl_ms()``
+          supersedes it: the stale grant is dropped and the key's epoch is
+          bumped past the contested slot, so the new transaction is
+          granted at a strictly HIGHER timestamp.
+
+        Safety argument for reclamation (why it cannot orphan a
+        certificate that ever reached 2f+1 validly):
+
+        1. A write certificate is SELF-CERTIFYING: ``process_write2``
+           validates 2f+1 signed in-set grants, hash agreement and
+           staleness — it never consults this replica's grant book.
+           Reclaiming a grant therefore cannot invalidate any certificate
+           already assembled from it; a slow-but-live client whose grants
+           were reclaimed mid-flight still commits when its Write2 lands
+           (pinned in tests/test_chaos.py).
+        2. The reclaimed slot is NEVER re-granted: the superseding grant
+           is issued at ``epoch_of(slot) + EPOCH_UNIT + seed``, strictly
+           above the reclaimed timestamp, and prospective timestamps only
+           ever grow with the epoch — so no two conflicting transactions
+           can each hold an honest grant for ONE (key, ts) slot, and the
+           certificate-agreement invariant is untouched.
+        3. The only interleaving left is two certificates at DIFFERENT
+           timestamps racing to commit, which is the protocol's ordinary
+           concurrent-writer case: the staleness check orders them by
+           timestamp on every honest replica identically.
+        4. Auditability: each reclaim records (key, ts) -> granted hash in
+           ``self.reclaimed``; the InvariantChecker convicts any committed
+           certificate occupying a reclaimed slot with a DIFFERENT hash
+           (which per 2 would require a forged or Byzantine grant).
+        """
         if not 0 <= req.seed < EPOCH_UNIT:
             # A Byzantine client must not steer prospective timestamps into
             # arbitrary epochs (epoch-jump / grant-GC attacks).
             raise BadRequest(f"seed {req.seed} outside [0, {EPOCH_UNIT})")
+        now = time.monotonic()
+        quota = CLIENT_GRANT_QUOTA
+        ttl_ms = effective_grant_ttl_ms()  # module globals; fixed per request
+        # Quota accounting counts the REQUEST's grant demand too, not just
+        # prior state: one Write1 issues a grant per distinct owned data
+        # key, so checking outstanding alone would let a single wide
+        # transaction hoard arbitrarily many slots in one message.  The
+        # quota is therefore also the ceiling on distinct keys per write
+        # transaction — size MOCHI_CLIENT_GRANT_QUOTA above the widest
+        # transaction a workload legitimately issues (config keys exempt).
+        owned_keys = {
+            op.key
+            for op in req.transaction.operations
+            if op.key
+            and not op.key.startswith(CONFIG_KEY_PREFIX)
+            and self.owns(op.key)
+        }
+        if quota > 0 and owned_keys:
+            held = self.client_stats_map.get(req.client_id)
+            outstanding = held["outstanding"] if held else 0
+            # Demand = keys that would issue a NEW grant: a key already
+            # granted to THIS transaction at THIS request's prospective
+            # timestamp costs nothing (the idempotent retry of a lost
+            # Write1Ok — possibly partial — must never be quota-refused,
+            # or the client can't recover its own in-flight write).  The
+            # credit is deliberately per-SLOT, not per-key: "already holds
+            # some grant on the key" would let one identity sweep the
+            # key's whole seed space for the price of one slot — the
+            # exact wedge the quota exists to cap.
+            demand = 0
+            for k in owned_keys:
+                sv = self._get(k)
+                g = (
+                    sv.grant_at(sv.current_epoch + req.seed)
+                    if sv is not None
+                    else None
+                )
+                if g is None or g.transaction_hash != req.transaction_hash:
+                    demand += 1
+            if outstanding + demand > quota:
+                # Amortized decay: before refusing, sweep THIS client's
+                # TTL-aged grants (abandoned contention rounds would
+                # otherwise pin the quota forever — nothing conflicts
+                # with an abandoned slot, so the lazy reclaim never runs).
+                if held is not None and self._sweep_expired_grants(
+                    req.client_id, now
+                ):
+                    outstanding = held["outstanding"]
+                if outstanding + demand > quota:
+                    entry = self._client_entry(req.client_id)
+                    entry["quota_refused"] += 1
+                    self.quota_refusals += 1
+                    # Retry-after: the oldest outstanding slots free
+                    # within one TTL (the sweep above enforces it); with
+                    # reclamation off, hint a modest backoff, not a lie.
+                    raise QuotaExceeded(
+                        f"client {req.client_id} holds {outstanding} "
+                        f"outstanding grants and asks {demand} more "
+                        f"(quota {quota})",
+                        retry_after_ms=int(ttl_ms) if ttl_ms > 0 else 250,
+                    )
         grants: Dict[str, Grant] = {}
         current_certs: Dict[str, WriteCertificate] = {}
         all_ok = True
@@ -361,11 +715,41 @@ class DataStore:
             sv = self._get_or_create(op.key)
             prospective_ts = sv.current_epoch + req.seed
             existing = sv.grant_at(prospective_ts)
+            if existing is not None and existing.transaction_hash != req.transaction_hash:
+                # Conflicting outstanding grant: reclaim it if it has aged
+                # past the TTL (see the safety argument above), else refuse.
+                meta = self._grant_meta.get((op.key, prospective_ts))
+                if (
+                    ttl_ms > 0
+                    and meta is not None
+                    and (now - meta[1]) * 1e3 >= ttl_ms
+                ):
+                    # Supersede at a strictly higher timestamp: the shared
+                    # reclaim ledgers the slot, releases its quota, and
+                    # bumps the epoch past it (advance_epoch also GC's
+                    # ancient hoarded epochs — their quota frees too).
+                    self._reclaim_slot(sv, op.key, prospective_ts)
+                    prospective_ts = sv.current_epoch + req.seed
+                    existing = sv.grant_at(prospective_ts)
+                    # (the bumped epoch is fresh: nothing can be granted
+                    # there yet, so existing is None and the issue path
+                    # below runs — kept as a lookup, not an assert, so a
+                    # future epoch-handling change degrades to a refusal
+                    # rather than a double grant)
             if existing is None:
                 grant = Grant(
                     op.key, prospective_ts, self.config.configstamp, req.transaction_hash, Status.OK
                 )
                 sv.add_grant(grant)
+                # Config-keyspace grants sit entirely OUTSIDE the
+                # quota/reclaim/wedge machinery: that keyspace is
+                # admin-gated (its own protection), and an operator's
+                # stalled reconfiguration grant must neither consume the
+                # identity's data-key quota nor have its epochs bumped by
+                # the expiry sweep.
+                if not op.key.startswith(CONFIG_KEY_PREFIX):
+                    self._track_grant(op.key, prospective_ts, req.client_id, now)
+                    self._wedge_close(op.key, now, req.client_id)
                 grants[op.key] = grant
             elif existing.transaction_hash == req.transaction_hash:
                 # Idempotent retry (ref: InMemoryDataStore.java:141-148)
@@ -377,6 +761,8 @@ class DataStore:
                     op.key, prospective_ts, self.config.configstamp, req.transaction_hash, Status.REFUSED
                 )
                 all_ok = False
+                if not op.key.startswith(CONFIG_KEY_PREFIX):
+                    self._wedge_open(op.key, req.client_id, now)
                 # The conflicting CURRENT state rides only the refusal —
                 # that is what the echo exists for (the reference's
                 # conflicting-state return).  Echoing every granted key's
@@ -649,7 +1035,11 @@ class DataStore:
         sv.current_certificate = wc
         sv.last_transaction = transaction
         sv.delete_grant(ts)
-        sv.advance_epoch(ts)
+        now = time.monotonic()
+        self._untrack_grant(op.key, ts)  # grant consumed: quota released
+        for dts in sv.advance_epoch(ts):
+            self._untrack_grant(op.key, dts)  # GC'd epochs release quota too
+        self._wedge_close(op.key, now)  # a commit un-wedges the key
         if op.action == Action.WRITE:
             sv.value = op.value
             sv.exists = True
@@ -760,3 +1150,16 @@ class BadCertificate(Exception):
 
 class BadRequest(Exception):
     """Request failed input validation (out-of-range seed, empty key, ...)."""
+
+
+class QuotaExceeded(BadRequest):
+    """The sender's per-client outstanding-grant quota is exhausted
+    (``CLIENT_GRANT_QUOTA``).  Subclasses :class:`BadRequest` so every
+    existing per-request isolation path treats it as a typed refusal
+    value; the replica maps it to ``FailType.QUOTA_EXCEEDED`` with the
+    ``retry_after_ms`` hint (PR-8 admission plumbing) instead of a plain
+    BAD_REQUEST."""
+
+    def __init__(self, msg: str, retry_after_ms: int = 0):
+        super().__init__(msg)
+        self.retry_after_ms = retry_after_ms

@@ -1,11 +1,14 @@
 from .byzantine import STRATEGIES, AttackStrategy, ByzantineReplica, make_strategy
+from .byzantine_client import CLIENT_STRATEGIES, ByzantineClient
 from .invariants import InvariantChecker
 from .process_cluster import ProcessCluster
 from .virtual_cluster import VirtualCluster
 
 __all__ = [
     "AttackStrategy",
+    "ByzantineClient",
     "ByzantineReplica",
+    "CLIENT_STRATEGIES",
     "InvariantChecker",
     "ProcessCluster",
     "STRATEGIES",

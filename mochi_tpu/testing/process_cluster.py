@@ -335,6 +335,14 @@ class ProcessCluster:
         self._clients.append(client)
         return client
 
+    def byzantine_client(self, strategy: str = "withhold", seed: int = 0, **kwargs):
+        """Byzantine CLIENT over the real process boundary: same wrapper as
+        ``VirtualCluster.byzantine_client`` — the children see validly
+        signed hostile traffic arriving over real sockets."""
+        from .byzantine_client import ByzantineClient
+
+        return ByzantineClient(self.client(**kwargs), strategy=strategy, seed=seed)
+
     def check_alive(self) -> None:
         """Raise if any child exited (crash detection between test phases)."""
         for sp in self.processes:

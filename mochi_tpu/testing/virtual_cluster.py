@@ -208,6 +208,16 @@ class VirtualCluster:
         self._clients.append(client)
         return client
 
+    def byzantine_client(self, strategy: str = "withhold", seed: int = 0, **kwargs):
+        """A Byzantine CLIENT (testing/byzantine_client.py) wrapping a real
+        SDK instance from :meth:`client` — real keypair, real sessions,
+        registered like any client — so its hostile traffic is validly
+        authenticated.  Composable with the ``byzantine={...}`` replica
+        adversaries in the same cluster."""
+        from .byzantine_client import ByzantineClient
+
+        return ByzantineClient(self.client(**kwargs), strategy=strategy, seed=seed)
+
     def replica(self, server_id: str) -> MochiReplica:
         return next(r for r in self.replicas if r.server_id == server_id)
 

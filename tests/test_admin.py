@@ -62,6 +62,17 @@ async def _main():
                       "sendq_out_bytes", "sendq_total_bytes",
                       "paused_conns", "verify_inflight", "retry_after_ms"):
                 assert k in ov, k
+            # per-client grant/quota/reclaim surface (round 13, docs
+            # §4h): knobs + wedge liveness metric + per-identity ledger
+            cl = doc["clients"]
+            for k in ("quota", "ttl_ms", "reclaims", "quota_refused",
+                      "outstanding_total", "max_wedge_ms", "open_wedges",
+                      "per_client", "quota_refusals_served"):
+                assert k in cl, k
+            assert cl["reclaims"] == 0 and cl["quota_refused"] == 0
+            if replica.server_id in replica.config.replica_set_for_key("adm-key"):
+                me = cl["per_client"].get(client.client_id, {})
+                assert me.get("issued", 0) >= 1, cl["per_client"]
 
             status, _, body = await loop.run_in_executor(None, _get, port, "/metrics")
             assert status == 200
@@ -77,6 +88,12 @@ async def _main():
             assert 'mochi_shed{stat="shed_p"' in body
             assert 'mochi_shed{stat="sendq_out_bytes"' in body
             assert 'mochi_shed{stat="sessions.size"' in body
+            # per-client grant accounting: aggregate rows (client="") plus
+            # one row per tracked identity
+            assert 'mochi_client{client="",stat="reclaims"' in body
+            assert 'mochi_client{client="",stat="quota"' in body
+            if replica.server_id in replica.config.replica_set_for_key("adm-key"):
+                assert f'mochi_client{{client="{client.client_id}"' in body
             # every sample line: name{labels} value
             for line in body.splitlines():
                 if line and not line.startswith("#"):
@@ -94,6 +111,8 @@ async def _main():
                 assert other.server_id in body and other.url in body
             assert "Membership" in body and "Store" in body and "Verifier" in body
             assert "Overload" in body and "shed_p" in body
+            # the round-13 Clients table: quota knobs + wedge metric rows
+            assert "Clients" in body and "max_wedge_ms" in body
         finally:
             await admin.close()
 
@@ -159,6 +178,13 @@ async def _fanout_main():
             _, ctype, body = await loop.run_in_executor(None, _get, port, "/")
             assert ctype == "text/html"
             assert "Fan-out" in body and "server-2" in body
+            # the client shell's own grant/quota view (round 13): the
+            # Clients table plus its /status "clients" key
+            assert "Clients" in body and "quota_refusals" in body
+            _, _, body = await loop.run_in_executor(None, _get, port, "/status")
+            doc = json.loads(body)
+            assert doc["clients"]["quota_refusals"] == 0
+            assert "per_replica_quota_refused" in doc["clients"]
         finally:
             await cadmin.close()
 

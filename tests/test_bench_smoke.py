@@ -28,7 +28,7 @@ def _run(args, timeout):
     )
 
 
-def test_run_all_smoke_covers_all_ten_configs():
+def test_run_all_smoke_covers_all_eleven_configs():
     proc = _run(["--smoke"], timeout=480)
     assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-800:]
     recs = [
@@ -37,9 +37,9 @@ def test_run_all_smoke_covers_all_ten_configs():
         if line.startswith("{")
     ]
     by_config = {r.get("config"): r for r in recs}
-    # configs 1-10: 9 (open-loop overload) joined in round 12
+    # configs 1-11: 11 (byzantine clients) joined in round 13
     assert sorted(by_config, key=int) == [
-        str(i) for i in range(1, 11)
+        str(i) for i in range(1, 12)
     ], sorted(by_config)
     for key, rec in sorted(by_config.items()):
         assert not rec.get("error"), (key, rec)

@@ -273,6 +273,119 @@ def test_invariant_checker_is_not_vacuous():
     run(main())
 
 
+def test_colluding_replicas_rf7_f2_invariants_hold():
+    """Colluding adversaries WITHIN the fault bound at larger rf: rf=7 →
+    f=2, quorum=5, with two coordinated attackers (an equivocator and a
+    cert-forger) serving live traffic.  Writes must converge through the
+    5 honest replicas, forged grants must be filtered client-side, and
+    every safety invariant must hold at f=2."""
+
+    async def main():
+        async with VirtualCluster(
+            7,
+            rf=7,
+            byzantine={"server-1": "equivocate", "server-2": "forge-cert"},
+        ) as vc:
+            assert vc.config.f == 2 and vc.config.quorum == 5
+            checker = InvariantChecker(
+                vc.honest_replicas(), ["server-1", "server-2"]
+            )
+            checker.start(0.02)
+            client = vc.client(timeout_s=2.0)
+            await _workload(vc, checker, client, keys=4, sweeps=2, prefix="f2")
+            for k in range(4):
+                res = await client.execute_read_transaction(
+                    TransactionBuilder().read(f"f2-{k}").build()
+                )
+                assert res.operations[0].value == b"v1"
+            await checker.final_check(client)
+            await checker.stop()
+            assert checker.ok, checker.report()["violations"]
+            # the forger's garbage grants were filtered and attributed
+            sus = client.suspicion_stats().get("server-2", {})
+            assert sus.get("bad-grant", 0) > 0, client.suspicion_stats()
+
+    run(main())
+
+
+def test_checker_convicts_when_fault_bound_exceeded_f3():
+    """Checker non-vacuity AT SCALE: with f+1=3 colluding equivocators in
+    an rf=7 (f=2) cluster, two conflicting transactions can each assemble
+    a legitimate-looking 5-grant certificate for the SAME (key, ts) slot
+    — 2 honest grants + 3 equivocated each — and commit on disjoint
+    honest replicas.  Safety is genuinely violated, and the
+    InvariantChecker must say so (a checker that stays green past the
+    fault bound proves nothing within it)."""
+
+    async def main():
+        byz_ids = ["server-1", "server-2", "server-6"]
+        async with VirtualCluster(
+            7, rf=7, byzantine={sid: "equivocate" for sid in byz_ids}
+        ) as vc:
+            client = vc.client(timeout_s=2.0)
+            txn_a = TransactionBuilder().write("ovr", b"A").build()
+            txn_b = TransactionBuilder().write("ovr", b"B").build()
+            halves = {id(txn_a): ["server-0", "server-3"],
+                      id(txn_b): ["server-4", "server-5"]}
+            certs = {}
+            for txn in (txn_a, txn_b):
+                blind = client._write1_transaction(txn)
+                grants = []
+                for sid in halves[id(txn)] + byz_ids:
+                    env = client._envelope(
+                        Write1ToServer(
+                            client.client_id, blind, 77, transaction_hash(txn)
+                        ),
+                        f"f3-w1-{sid}-{id(txn)}",
+                    )
+                    resp = await client.pool.send_and_receive(
+                        vc.config.servers[sid], env
+                    )
+                    # honest replicas that never saw the other txn grant
+                    # genuinely; the equivocators flip their refusals
+                    assert isinstance(resp.payload, Write1OkFromServer), (
+                        sid, resp.payload
+                    )
+                    grants.append(resp.payload.multi_grant)
+                ts = {
+                    mg.grants["ovr"].timestamp for mg in grants
+                }
+                assert len(ts) == 1, ts  # one slot, both transactions
+                certs[id(txn)] = WriteCertificate(
+                    {mg.server_id: mg for mg in grants}
+                )
+            checker = InvariantChecker(vc.honest_replicas(), byz_ids)
+            # commit A on one honest pair, B on the other: disjoint honest
+            # replicas now hold conflicting certificates for one slot
+            for txn in (txn_a, txn_b):
+                for sid in halves[id(txn)]:
+                    env = client._envelope(
+                        Write2ToServer(certs[id(txn)], txn),
+                        f"f3-w2-{sid}-{id(txn)}",
+                    )
+                    await client.pool.send_and_receive(
+                        vc.config.servers[sid], env
+                    )
+            checker.check_now()
+            report = checker.report()
+            assert not report["ok"], "checker vacuous past the fault bound"
+            assert any("conflicting commits" in v for v in report["violations"])
+            # presenting BOTH certificates to one honest replica also
+            # convicts the equivocators cryptographically (grant ledger)
+            for txn in (txn_a, txn_b):
+                env = client._envelope(
+                    Write2ToServer(certs[id(txn)], txn),
+                    f"f3-ev-{id(txn)}",
+                )
+                await client.pool.send_and_receive(
+                    vc.config.servers["server-0"], env
+                )
+            eq = vc.replica("server-0").byzantine_stats()["equivocations"]
+            assert any(eq.get(sid, 0) >= 1 for sid in byz_ids), eq
+
+    run(main())
+
+
 def test_process_cluster_byzantine_silent_commits_cross_process():
     """ByzantineReplica across a REAL process boundary: ProcessCluster
     forwards --byzantine to the hosting child, the silent child answers

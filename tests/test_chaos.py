@@ -240,6 +240,77 @@ def test_acked_writes_survive_lossy_wan_partition_heal():
     run(asyncio.wait_for(main(), timeout=240))
 
 
+def test_slow_honest_client_survives_grant_reclaim_race():
+    """Round-13 regression: grant-TTL reclamation must never cost a
+    merely-SLOW honest client its write.  The client acquires its full
+    grant set, then stalls past the TTL mid-Write2 (a WAN-delayed or
+    GC-paused coordinator); a contender's conflicting Write1 reclaims the
+    now-aged slots.  When the slow client's Write2 finally lands, it must
+    still apply — the certificate is self-certifying; reclamation only
+    touches the grant book — and the reclaimed-slot invariant must hold
+    (the committed certificate IS the original grantee's)."""
+    from mochi_tpu.protocol import (
+        Write1OkFromServer,
+        Write1ToServer,
+        WriteCertificate,
+        transaction_hash,
+    )
+    from mochi_tpu.server import store as store_mod
+    from mochi_tpu.testing.invariants import InvariantChecker
+
+    async def main():
+        saved = store_mod.GRANT_TTL_MS
+        store_mod.GRANT_TTL_MS = 200.0
+        try:
+            sim = NetSim.mesh(seed=5, rtt_ms=4.0, jitter_ms=0.5)
+            async with VirtualCluster(4, rf=4, netsim=sim) as vc:
+                slow = vc.client(timeout_s=2.0)
+                key = "slowk"
+                txn = TransactionBuilder().write(key, b"slow-v").build()
+                txn_hash = transaction_hash(txn)
+                write1_txn = slow._write1_transaction(txn)
+                seed = 123
+                w1 = Write1ToServer(slow.client_id, write1_txn, seed, txn_hash)
+                responses = await slow._fan_out(write1_txn, lambda: w1)
+                oks = [
+                    p.multi_grant
+                    for p in responses.values()
+                    if isinstance(p, Write1OkFromServer)
+                    and slow._grant_ok(p.multi_grant, txn_hash)
+                ]
+                chosen = slow._quorum_grant_subset(txn, oks)
+                assert chosen is not None
+                certificate = WriteCertificate(
+                    {mg.server_id: mg for mg in chosen}
+                )
+                # ...the coordinator stalls past the TTL mid-write...
+                await asyncio.sleep(0.35)
+                # ...while a contender's conflicting Write1 (same slot)
+                # triggers reclamation of the aged grants
+                contender = vc.byzantine_client("withhold")
+                await contender.acquire(key, seed)
+                assert sum(r.store.reclaims for r in vc.replicas) > 0, (
+                    "the race never happened — nothing was reclaimed"
+                )
+                # the slow client's Write2 lands AFTER its grants were
+                # reclaimed: reclaim races resolve toward the certificate
+                result = await slow._write2(txn, certificate)
+                assert result.operations[0].status.name == "OK"
+                res = await slow.execute_read_transaction(
+                    TransactionBuilder().read(key).build()
+                )
+                assert res.operations[0].value == b"slow-v"
+                checker = InvariantChecker(vc.replicas)
+                checker.record_ack(key, b"slow-v")
+                checker.check_now()
+                await checker.final_check(slow)
+                assert checker.ok, checker.report()["violations"]
+        finally:
+            store_mod.GRANT_TTL_MS = saved
+
+    run(asyncio.wait_for(main(), timeout=120))
+
+
 def test_byzantine_adversary_under_loss_invariants_and_acked_writes_hold():
     """ROADMAP item 4 remainder (config-10 legs run a CLEAN mesh): a live
     adversary AND 2% frame loss together — the storm strategy's refusal
