@@ -17,8 +17,12 @@ baseline files, file walking, and the runner.
 Suppression syntax (see docs/ANALYSIS.md): a finding on line N is suppressed
 by a comment on line N or on line N-1 of the form::
 
-    # mochi-lint: disable=<rule>[,<rule>...]
-    # mochi-lint: disable=all
+    # mochi-lint: disable=<rule>[,<rule>...] -- <one-line justification>
+
+(``all`` disables every rule; the justification after the rule list is
+required by review etiquette, not the parser.  Written with a ``<rule>``
+placeholder here so this docstring is not itself a live suppression — the
+hygiene pass scans raw lines, docstrings included.)
 
 Baseline: a JSON file ``{"fingerprints": [...]}``.  Findings whose
 fingerprint appears in the baseline are reported as "baselined" and do not
@@ -53,6 +57,13 @@ class Finding:
     # violations in one file would share a fingerprint and one baseline
     # entry would grandfather both — the ratchet could move backwards.
     occurrence: int = 0
+    # Severity tier ("error" is the classic single-tier default).  Tiered
+    # checkers (await-races) emit high/medium/advice so triage can rank a
+    # check-then-act on a bounded table above an iteration hazard; every
+    # tier still FAILS the run — tiers order the work, they don't excuse
+    # it.  Not part of the fingerprint: re-tiering a rule must not
+    # invalidate baselines or suppressions.
+    severity: str = "error"
 
     @property
     def fingerprint(self) -> str:
@@ -64,12 +75,19 @@ class Finding:
         return hashlib.sha256(basis.encode()).hexdigest()[:16]
 
     def render(self) -> str:
-        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] {self.message}"
+        sev = "" if self.severity == "error" else f"/{self.severity}"
+        return f"{self.path}:{self.line}:{self.col}: [{self.rule}{sev}] {self.message}"
 
 
 # --------------------------------------------------------------- suppressions
 
-_SUPPRESS_RE = re.compile(r"#\s*mochi-lint:\s*disable=([A-Za-z0-9_,\- ]+)")
+# ``#`` for Python, ``//`` / ``/*`` for the native seam (analysis covers
+# ``native/*.c`` since the const-time lexer pass landed).
+_SUPPRESS_RE = re.compile(
+    # rule tokens only (comma-separated); trailing prose is the REQUIRED
+    # one-line justification and must not bleed into the rule list
+    r"(?:#|//|/\*)\s*mochi-lint:\s*disable=([A-Za-z0-9_\-]+(?:\s*,\s*[A-Za-z0-9_\-]+)*)"
+)
 
 
 def suppressions_by_line(src: str) -> Dict[int, Set[str]]:
@@ -94,6 +112,17 @@ def is_suppressed(finding: Finding, supp: Dict[int, Set[str]]) -> bool:
     return False
 
 
+def suppression_line_for(finding: Finding, supp: Dict[int, Set[str]]) -> Optional[int]:
+    """The comment line that suppressed ``finding`` (same-line wins), or
+    None — the accounting the suppression-hygiene rule needs to tell a
+    LOAD-BEARING comment from a stale one."""
+    for line in (finding.line, finding.line - 1):
+        rules = supp.get(line)
+        if rules and ("all" in rules or finding.rule in rules):
+            return line
+    return None
+
+
 # ------------------------------------------------------------------- baseline
 
 
@@ -105,7 +134,25 @@ def load_baseline(path: Optional[str]) -> Set[str]:
     return set(doc.get("fingerprints", []))
 
 
-def write_baseline(path: str, findings: Sequence[Finding]) -> None:
+def load_baseline_paths(path: Optional[str]) -> Optional[Set[str]]:
+    """The display-path set the baseline was written against, or None for
+    a legacy/absent baseline that never recorded one.  Staleness of a
+    fingerprint can only be judged by a run that scanned AT LEAST these
+    files — an unmatched entry on a narrower run may simply belong to a
+    file that wasn't looked at."""
+    if not path or not os.path.exists(path):
+        return None
+    with open(path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    paths = doc.get("paths")
+    return None if paths is None else set(paths)
+
+
+def write_baseline(
+    path: str,
+    findings: Sequence[Finding],
+    scanned: Optional[Sequence[str]] = None,
+) -> None:
     doc = {
         "comment": (
             "mochi_tpu.analysis baseline: findings listed here are "
@@ -114,6 +161,10 @@ def write_baseline(path: str, findings: Sequence[Finding]) -> None:
         ),
         "fingerprints": sorted({f.fingerprint for f in findings}),
     }
+    if scanned is not None:
+        # coverage record: the suppression-hygiene pass convicts stale
+        # fingerprints only on runs that re-scan at least these files
+        doc["paths"] = sorted(set(scanned))
     with open(path, "w", encoding="utf-8") as fh:
         json.dump(doc, fh, indent=2)
         fh.write("\n")
@@ -226,13 +277,24 @@ def display_path(fp: str, scan_root: Optional[str] = None) -> str:
     return f"{parent}/{name}" if parent else name
 
 
-def iter_python_files(paths: Sequence[str]) -> List[Tuple[str, str]]:
-    """``(display_path, filesystem_path)`` pairs for every .py under paths."""
+# Scanned source kinds: Python gets the AST checkers; .c gets the lexical
+# native checkers (LANG = "c" modules).  display_path/fingerprint/baseline
+# machinery is shared — a native finding baselines and suppresses exactly
+# like a Python one.
+SOURCE_EXTS = (".py", ".c")
+
+
+def iter_python_files(
+    paths: Sequence[str], exts: Sequence[str] = SOURCE_EXTS
+) -> List[Tuple[str, str]]:
+    """``(display_path, filesystem_path)`` pairs for every source file
+    (``exts``) under paths.  (Name kept from the .py-only era — callers and
+    tests use it directly.)"""
     out: Dict[str, Tuple[str, str]] = {}  # abspath -> (display, fs path)
     for path in paths:
         norm = os.path.normpath(path)
         if os.path.isfile(norm):
-            if norm.endswith(".py"):
+            if norm.endswith(tuple(exts)):
                 out.setdefault(os.path.abspath(norm), (display_path(norm), norm))
             continue
         for dirpath, dirnames, filenames in os.walk(norm):
@@ -240,7 +302,7 @@ def iter_python_files(paths: Sequence[str]) -> List[Tuple[str, str]]:
                 d for d in dirnames if not d.startswith(".") and d != "__pycache__"
             ]
             for fn in sorted(filenames):
-                if fn.endswith(".py"):
+                if fn.endswith(tuple(exts)):
                     fp = os.path.join(dirpath, fn)
                     out.setdefault(
                         os.path.abspath(fp), (display_path(fp, scan_root=norm), fp)
@@ -253,13 +315,23 @@ def _checkers():
     # checker modules themselves without a cycle.
     from . import (
         async_blocking,
+        await_races,
         cancellation,
         const_time,
         invariants,
+        native_ct,
         trace_safety,
     )
 
-    return [async_blocking, cancellation, trace_safety, const_time, invariants]
+    return [
+        async_blocking,
+        cancellation,
+        trace_safety,
+        const_time,
+        invariants,
+        await_races,
+        native_ct,
+    ]
 
 
 def all_rules() -> List[str]:
@@ -274,10 +346,16 @@ class RunResult:
     baselined: List[Finding] = field(default_factory=list)
     suppressed: List[Finding] = field(default_factory=list)
     files_scanned: int = 0
+    # display paths actually scanned — recorded into the baseline by
+    # --write-baseline so later runs know the coverage staleness needs
+    scanned: List[str] = field(default_factory=list)
 
     @property
     def clean(self) -> bool:
         return not self.new
+
+
+HYGIENE_RULE = "suppression-hygiene"
 
 
 def run(
@@ -285,12 +363,21 @@ def run(
     rules: Optional[Sequence[str]] = None,
     baseline: Optional[str] = None,
     scoped: bool = True,
+    hygiene: bool = False,
 ) -> RunResult:
     """Run the pass over ``paths`` (files or directories).
 
     ``rules`` restricts to a subset of checkers; ``scoped=False`` drops the
     per-checker path scoping (used by the fixture tests, whose snippets live
     under tests/ where e.g. the trace-safety scope would never look).
+
+    ``hygiene=True`` (the CLI default on full-rule runs) makes rot itself a
+    finding: a ``mochi-lint: disable`` comment that suppressed nothing this
+    run, and a baseline fingerprint no current finding matches, each report
+    under ``suppression-hygiene`` — the mechanism that keeps the suppression
+    surface and the baseline from quietly outliving the code they excused.
+    Meaningless under a rule subset (every other rule's suppressions would
+    look unused), so it is force-disabled there.
     """
     checkers = _checkers()
     if rules is not None:
@@ -299,7 +386,9 @@ def run(
         if unknown:
             raise ValueError(f"unknown rules: {sorted(unknown)}")
         checkers = [mod for mod in checkers if mod.RULE in wanted]
+        hygiene = False
     known = load_baseline(baseline)
+    matched_baseline: Set[str] = set()
     result = RunResult()
     for rel, filepath in iter_python_files(paths):
         try:
@@ -310,34 +399,93 @@ def run(
                 Finding("parse-error", rel, 1, 0, f"unreadable: {exc}")
             )
             continue
-        try:
-            tree = ast.parse(src, filename=rel)
-        except SyntaxError as exc:
-            result.new.append(
-                Finding(
-                    "parse-error", rel, exc.lineno or 1, exc.offset or 0,
-                    f"syntax error: {exc.msg}",
+        is_c = filepath.endswith(".c")
+        tree = None
+        if not is_c:
+            try:
+                tree = ast.parse(src, filename=rel)
+            except SyntaxError as exc:
+                result.new.append(
+                    Finding(
+                        "parse-error", rel, exc.lineno or 1, exc.offset or 0,
+                        f"syntax error: {exc.msg}",
+                    )
                 )
-            )
-            continue
+                continue
         result.files_scanned += 1
+        result.scanned.append(rel)
         supp = suppressions_by_line(src)
         file_findings: List[Finding] = []
         for mod in checkers:
+            if (getattr(mod, "LANG", "py") == "c") != is_c:
+                continue
             file_findings.extend(mod.check(tree, src, rel, scoped=scoped))
         # Occurrence indices in deterministic (line, col) order, so each of
         # N identical snippets gets its own fingerprint (see Finding).
         seen_snippets: Dict[Tuple[str, str], int] = {}
+        used_supp_lines: Set[int] = set()
         for finding in sorted(file_findings, key=lambda f: (f.line, f.col)):
             key = (finding.rule, finding.snippet.strip())
             idx = seen_snippets.get(key, 0)
             seen_snippets[key] = idx + 1
             if idx:
                 finding = replace(finding, occurrence=idx)
-            if is_suppressed(finding, supp):
+            supp_line = suppression_line_for(finding, supp)
+            if supp_line is not None:
+                used_supp_lines.add(supp_line)
                 result.suppressed.append(finding)
             elif finding.fingerprint in known:
+                matched_baseline.add(finding.fingerprint)
                 result.baselined.append(finding)
             else:
                 result.new.append(finding)
+        if hygiene:
+            src_lines = src.splitlines()
+            for line, named in sorted(supp.items()):
+                if line in used_supp_lines:
+                    continue
+                # Only convict a comment this run could have vindicated:
+                # every named rule (or "all") must be among the checkers
+                # that actually ran over this file kind.
+                ran = {
+                    mod.RULE
+                    for mod in checkers
+                    if (getattr(mod, "LANG", "py") == "c") == is_c
+                }
+                if "all" not in named and not named <= ran:
+                    continue
+                result.new.append(
+                    Finding(
+                        HYGIENE_RULE, rel, line, 0,
+                        f"unused suppression (disable={','.join(sorted(named))}): "
+                        "no finding on this or the next line needs it — delete "
+                        "the comment (or fix the drift that orphaned it)",
+                        snippet_at(src_lines, line),
+                    )
+                )
+    if hygiene and known:
+        # Staleness is only decidable with coverage: an unmatched entry on
+        # a partial-path run may belong to a file this run never scanned
+        # (convicting it — and the message's --write-baseline advice —
+        # would silently amnesty every unscanned file's debt).  The
+        # baseline records the display paths it was written against;
+        # convict only when this run re-scanned ALL of them (display paths
+        # are cwd-independent, so set containment is exact).  A legacy
+        # baseline without the record — or one referencing a since-deleted
+        # file — never convicts; regenerating once upgrades/heals it.
+        recorded = load_baseline_paths(baseline)
+        covered = recorded is not None and recorded <= set(result.scanned)
+        if covered:
+            stale = sorted(known - matched_baseline)
+            base_rel = display_path(baseline) if baseline else "baseline"
+            for fp in stale:
+                result.new.append(
+                    Finding(
+                        HYGIENE_RULE, base_rel, 1, 0,
+                        f"stale baseline entry {fp}: no current finding "
+                        "matches it — prune it (python -m mochi_tpu.analysis "
+                        "--write-baseline regenerates)",
+                        snippet=fp,
+                    )
+                )
     return result

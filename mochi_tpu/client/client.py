@@ -408,6 +408,14 @@ class MochiDBClient:
                 )
                 return  # fall back to signed envelopes
             ack = res.payload
+            # Re-read after the handshake round trip: a reconfiguration can
+            # rotate sid's key while we were suspended, and the ack must
+            # verify against the key the CURRENT config trusts — the
+            # pre-await copy could accept a signature from a rotated-out
+            # identity (found by analysis: await-races/stale-read).
+            server_key = self.config.public_keys.get(sid)
+            if server_key is None:
+                return
             if isinstance(ack, RequestFailedFromServer) and self._server_signed(
                 sid, server_key, res
             ):
@@ -434,10 +442,24 @@ class MochiDBClient:
                 # RPC to every fan-out).  An UNSIGNED refusal falls through
                 # to the forged-ack WARNING below: suppressing sessions must
                 # cost an attacker a valid server signature.
+                if ack.fail_type == FailType.BAD_REQUEST:
+                    # Policy refusal (replica evict_client ban book):
+                    # an expected steady state like identity-unknown —
+                    # cache it, or every sessionless fan-out re-knocks,
+                    # paying a signed RPC per request and draining the
+                    # replica's GLOBAL handshake rate bucket that honest
+                    # clients' session setup shares.
+                    LOG.info(
+                        "%s refused session handshake (policy); staying "
+                        "on signatures for %gs", sid, SESSION_REFUSAL_TTL_S,
+                    )
+                    self._session_refused[sid] = (
+                        time.monotonic() + SESSION_REFUSAL_TTL_S
+                    )
+                    return
                 if ack.fail_type != FailType.BAD_SIGNATURE:
-                    # Only identity-unknown refusals are a cacheable steady
-                    # state; anything else is unexpected — log and retry on
-                    # the next request.
+                    # Anything else is unexpected — log and retry on the
+                    # next request.
                     LOG.warning(
                         "%s refused session handshake (%s); staying on signatures",
                         sid,

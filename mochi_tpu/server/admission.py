@@ -80,6 +80,9 @@ class SessionTable:
         self.ttl_s = ttl_s
         self._entries: Dict[str, tuple] = {}  # sender -> (key, last_used)
         self._pins: Dict[str, int] = {}
+        # senders whose policy eviction arrived while they were pinned
+        # mid-batch: dropped at final unpin, never between auth and seal
+        self._deferred_evictions: set = set()
         self.evictions = 0
 
     def __len__(self) -> int:
@@ -105,6 +108,9 @@ class SessionTable:
 
     def __setitem__(self, sender: str, key: bytes) -> None:
         now = time.monotonic()
+        # a fresh handshake supersedes any pending policy eviction — the
+        # ban (replica._client_bans) is what keeps an evicted client out
+        self._deferred_evictions.discard(sender)
         if sender in self._entries:
             del self._entries[sender]
         elif len(self._entries) >= self.max_entries:
@@ -122,8 +128,29 @@ class SessionTable:
         n = self._pins.get(sender, 0) - 1
         if n <= 0:
             self._pins.pop(sender, None)
+            if sender in self._deferred_evictions:
+                self._deferred_evictions.discard(sender)
+                if self._entries.pop(sender, None) is not None:
+                    self.evictions += 1
         else:
             self._pins[sender] = n
+
+    def evict(self, sender: str) -> str:
+        """Policy eviction (replica ``evict_client`` hook) that cannot
+        reintroduce the pin bug: a pinned (mid-batch) sender is marked for
+        deferred drop at its final unpin — its in-flight responses still
+        seal under the live session — while an unpinned one drops now.
+        Returns ``"evicted"``, ``"deferred"``, or ``"absent"``; purely
+        synchronous, so a caller's check-then-act stays in one loop turn.
+        """
+        if sender not in self._entries:
+            return "absent"
+        if sender in self._pins:
+            self._deferred_evictions.add(sender)
+            return "deferred"
+        del self._entries[sender]
+        self.evictions += 1
+        return "evicted"
 
     def _evict_one(self, now: float) -> None:
         """Capacity eviction: the first unpinned entry in dict order.

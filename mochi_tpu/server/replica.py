@@ -88,6 +88,12 @@ GRANT_LEDGER_SLOT_MAX = 8
 # check per forged message (the pre-batch price).
 OPTIMISTIC_CERT_ITEM_BUDGET = 256
 
+# Ban-book bound (evict_client): identities whose session handshakes this
+# replica refuses after a policy eviction.  FIFO-bounded like every other
+# per-client table — an adversary minting identities to churn the book can
+# at worst amnesty the OLDEST ban, never grow replica memory.
+CLIENT_BANS_MAX = 4096
+
 
 class MochiReplica:
     """One BFT replica node (ref: ``MochiServer.java`` + handler set)."""
@@ -149,6 +155,13 @@ class MochiReplica:
         # scale thousands of client sessions must cost bounded memory, and
         # an evicted client transparently re-handshakes.
         self._sessions = SessionTable()
+        # Policy-evicted identities (evict_client): a banned sender's
+        # re-handshake is refused, so "evicted" cannot silently mean
+        # "re-admitted one round trip later".  Ordered dict as FIFO set;
+        # signed-envelope traffic is deliberately NOT banned here —
+        # refusing signed work is the disconnect policy this hook is the
+        # seam for (ROADMAP item 4), not something to smuggle in.
+        self._client_bans: Dict[str, None] = {}
         # signing_bytes -> signature for MultiGrants THIS replica issued at
         # write1: the write2 own-grant check becomes a compare instead of a
         # deterministic re-sign (~57 us saved per write2).  Bounded FIFO; a
@@ -911,6 +924,23 @@ class MochiReplica:
                 ),
                 force_sign=True,
             )
+        # Ban book AFTER the rate valve: the refusal below is signed
+        # (force_sign — the client must be able to trust "you are banned"
+        # or a MITM could fake evictions), and the valve is what keeps
+        # signed refusals bounded under a banned-identity storm.
+        if env.sender_id in self._client_bans:
+            self.metrics.mark("replica.handshake-banned")
+            # BAD_REQUEST, not BAD_SIGNATURE — same reasoning as
+            # _admin_denied: this is policy, and BAD_SIGNATURE would make
+            # the client tear down unrelated valid sessions.
+            return self._respond(
+                env,
+                RequestFailedFromServer(
+                    FailType.BAD_REQUEST,
+                    "client evicted by policy; session handshake refused",
+                ),
+                force_sign=True,
+            )
         # The ack must be Ed25519-SIGNED (not MAC'd): its signature is
         # what proves to the initiator that no MITM swapped X25519 keys.
         # A MAC'd handshake request is meaningless — require signature
@@ -1164,6 +1194,7 @@ class MochiReplica:
                 # _CONFIG_CLUSTER_CS_* rungs; the prefix bounds the sweep.
                 for _ in range(2):
                     await asyncio.gather(
+                        # mochi-lint: disable=await-races -- stable peer snapshot by design: every pulled entry is certificate-validated, so a mid-resync reconfig can only shrink coverage, never corrupt state
                         *(pull_peer(info, CONFIG_KEY_PREFIX, None) for info in peers)
                     )
             # Pass 2: the requested keys (config keys re-apply as no-ops).
@@ -1299,7 +1330,44 @@ class MochiReplica:
         st["quota_refusals_served"] = self.metrics.counters.get(
             "replica.write1-quota-refused", 0
         )
+        st["banned_clients"] = len(self._client_bans)
         return st
+
+    def evict_client(self, client_id: str, ban: bool = True) -> Dict[str, object]:
+        """Policy eviction hook for one client identity — the safe seam the
+        disconnect policy (ROADMAP item 4 leftover) will drive from the
+        suspicion/quota ledgers.  Drops the MAC session and (by default)
+        bans re-handshakes; signed-envelope traffic is untouched.
+
+        Await-race audit (why this shape): everything consulted here — the
+        ``client_stats_map`` ledger entry, the session table, the ban book
+        — and the act itself run in ONE loop turn with no ``await``, so a
+        caller's check-then-act (read ledger, decide, evict) cannot be
+        split by a concurrent batch.  The one window the pass flagged as
+        structural is a batch already PAST auth, holding the session across
+        its verify round trip: ``SessionTable.evict`` defers exactly that
+        case (pinned ⇒ dropped at final unpin, in-flight responses still
+        seal), and the ban book — not eviction timing — is what keeps the
+        client out afterwards, since a fresh handshake legitimately
+        supersedes a deferred drop.  Outstanding Write1 grants are NOT
+        cancelled: revoking granted slots here would reintroduce the
+        reclaim/slow-Write2 race PR 9 closed — the grant TTL already bounds
+        them, and the quota ledger entry survives (it is never evicted
+        while outstanding), so a banned hoarder cannot shed its debt.
+        """
+        ledger = self.store.client_stats_map.get(client_id)
+        disposition = self._sessions.evict(client_id)
+        if ban and client_id not in self._client_bans:
+            if len(self._client_bans) >= CLIENT_BANS_MAX:
+                self._client_bans.pop(next(iter(self._client_bans)))
+            self._client_bans[client_id] = None
+        self.metrics.mark(f"replica.client-evicted.{disposition}")
+        return {
+            "client": client_id,
+            "session": disposition,
+            "banned": client_id in self._client_bans,
+            "outstanding_grants": 0 if ledger is None else ledger["outstanding"],
+        }
 
     def byzantine_stats(self) -> Dict[str, object]:
         """Per-peer misbehavior evidence for the admin surfaces (/status

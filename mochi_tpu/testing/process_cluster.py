@@ -382,9 +382,11 @@ class ProcessCluster:
         return out
 
     async def close(self) -> None:
-        for client in self._clients:
-            await client.close()
-        self._clients.clear()
+        # pop-until-empty: a client registered concurrently with close()
+        # (e.g. a bench leg still winding down) is closed too instead of
+        # tripping "changed size during iteration" on the live list
+        while self._clients:
+            await self._clients.pop().close()
         # TERM the replicas first (drains run concurrently) and collect
         # them; the verifier sidecar is signalled ONLY after every replica
         # has exited — a draining replica's admitted Write2 work still

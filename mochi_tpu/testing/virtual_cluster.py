@@ -249,14 +249,16 @@ class VirtualCluster:
         return fresh
 
     async def close(self) -> None:
-        for client in self._clients:
-            await client.close()
-        for replica in self.replicas:
+        # pop-until-empty on both lists: client()/restart_replica() racing a
+        # close() would mutate them mid-iteration (the awaits in the body
+        # suspend the loop) — late registrations get closed, not leaked
+        while self._clients:
+            await self._clients.pop().close()
+        while self.replicas:
+            replica = self.replicas.pop()
             if replica.verifier is not None:
                 await replica.verifier.close()
             await replica.close()
-        self.replicas.clear()
-        self._clients.clear()
         if self.netsim is not None:
             self.netsim.close()  # cancel schedule timers + in-flight frames
         if self._owns_uds_dir and self.uds_dir is not None:

@@ -1,7 +1,18 @@
 #!/usr/bin/env bash
 # Project-native static analysis over the production tree (docs/ANALYSIS.md).
-# Exit 0 = clean; exit 1 = new findings (fix them or add a justified
-# `# mochi-lint: disable=<rule>` suppression — do NOT re-baseline).
+#
+# Usage: scripts/lint.sh [GIT_REF]
+#   no ref -> full-strict: ANY new finding exits 1 (fix it or add a justified
+#             `# mochi-lint: disable=<rule> -- why` suppression — do NOT
+#             re-baseline).
+#   REF    -> diff-aware strict (the PR gate): findings in files changed vs
+#             REF (committed diff + working tree + untracked) exit 1;
+#             findings in untouched files print as warnings and exit 0 — a
+#             PR cannot add findings silently, and an unrelated tree-wide
+#             regression cannot block it either.
 set -euo pipefail
 cd "$(dirname "$0")/.."
+if [ $# -ge 1 ]; then
+  exec python -m mochi_tpu.analysis mochi_tpu/ scripts/ --changed-only "$1"
+fi
 exec python -m mochi_tpu.analysis mochi_tpu/ scripts/

@@ -37,6 +37,10 @@ def lint_gate() -> None:
     result = analysis_core.run(
         [os.path.join(_REPO, "mochi_tpu"), os.path.join(_REPO, "scripts")],
         baseline=os.path.join(_REPO, "config", "analysis_baseline.json"),
+        # hygiene: a stale suppression or baseline entry refuses the
+        # evaluation too — rot in the lint surface is exactly the kind of
+        # silent drift that turns a benchmark verdict unreviewable
+        hygiene=True,
     )
     if not result.clean:
         for finding in result.new:

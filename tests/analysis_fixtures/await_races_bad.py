@@ -1,0 +1,57 @@
+"""Seeded await-races violations — one site per sub-rule, line-distinct,
+plus a check-then-act hidden inside a ``match`` case body.
+
+Each coroutine reproduces the shape of a real pre-PR-10 bug class (see
+docs/ANALYSIS.md): the checker must flag exactly these five sites.
+"""
+
+import asyncio
+
+
+class QuorumTally:  # stand-in: the checker matches the constructor NAME
+    def add(self, response):
+        pass
+
+    @property
+    def chosen(self):
+        return None
+
+
+class Racy:
+    def __init__(self):
+        self.table = {}
+        self.pending = {}
+        self.peers = {}
+
+    async def check_then_act(self, key):
+        if key in self.table:  # guard runs in await segment 0...
+            await asyncio.sleep(0)
+            del self.table[key]  # BAD: ...act runs one await later, unverified
+
+    async def stale_read(self, key):
+        entry = self.pending.get(key)  # element read out of shared state
+        await asyncio.sleep(0)
+        return entry.seal()  # BAD: consumed one await later, never re-read
+
+    async def shared_iter(self):
+        for peer in self.peers:  # BAD: live shared container, await in body
+            await self.ping(peer)
+
+    async def tally_authority(self, responses):
+        tally = QuorumTally()
+        for response in responses:
+            tally.add(response)
+        await asyncio.sleep(0)
+        return tally.chosen  # BAD: liveness verdict consumed as authority
+
+    async def match_dispatch(self, cmd, key):
+        match cmd:
+            case "evict":
+                if key in self.table:  # guard runs in one segment...
+                    await asyncio.sleep(0)
+                    del self.table[key]  # BAD: check-then-act inside a case
+            case _:
+                pass
+
+    async def ping(self, peer):
+        await asyncio.sleep(0)

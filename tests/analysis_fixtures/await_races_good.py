@@ -1,0 +1,86 @@
+"""Safe counterparts for every await-races sub-rule: the checker must stay
+silent on all of these (each is the documented remediation idiom)."""
+
+import asyncio
+
+
+class QuorumTally:
+    def add(self, response):
+        pass
+
+    @property
+    def chosen(self):
+        return None
+
+
+class Careful:
+    def __init__(self):
+        self.table = {}
+        self.pending = {}
+        self.peers = {}
+        self._lock = asyncio.Lock()
+
+    async def double_checked(self, key):
+        if key in self.table:
+            await asyncio.sleep(0)
+            if key in self.table:  # re-validated in the act's own segment
+                del self.table[key]
+
+    async def locked_act(self, key):
+        if key in self.table:
+            async with self._lock:  # the lock serializes check and act
+                del self.table[key]
+
+    async def reread(self, key):
+        entry = self.pending.get(key)
+        await asyncio.sleep(0)
+        entry = self.pending.get(key)  # re-bound after the suspension
+        return entry
+
+    async def snapshot_iter(self):
+        for peer in list(self.peers):  # snapshot: mutation-safe iteration
+            await self.ping(peer)
+
+    async def copy_iter(self):
+        for peer in self.peers.copy():  # .copy() is a snapshot too
+            await self.ping(peer)
+
+    async def tally_before_await(self, responses):
+        tally = QuorumTally()
+        for response in responses:
+            tally.add(response)
+        verdict = tally.chosen  # consumed in the creation segment: fine
+        await asyncio.sleep(0)
+        return verdict
+
+    async def tuple_rebind(self, key):
+        entry = self.pending.get(key)
+        entry, rest = (key, None)  # tuple unpack: a FRESH value
+        await asyncio.sleep(0)
+        return entry, rest  # not stale — rebound before the suspension
+
+    async def loop_rebind(self, rows, key):
+        entry = self.pending.get(key)
+        for entry in rows:  # loop target: fresh binding each iteration
+            pass
+        await asyncio.sleep(0)
+        return entry
+
+    async def match_revalidated(self, cmd, key):
+        match cmd:
+            case "evict":
+                if key in self.table:
+                    await asyncio.sleep(0)
+                    if key in self.table:  # re-validated inside the case
+                        del self.table[key]
+            case str() as fresh_cmd:
+                await asyncio.sleep(0)
+                return fresh_cmd  # pattern capture: a fresh binding
+
+    async def ping(self, peer):
+        await asyncio.sleep(0)
+
+    def sync_mutation(self, key):
+        # no awaits — no schedule to race against
+        if key in self.table:
+            del self.table[key]

@@ -1,0 +1,31 @@
+/* Seeded native-const-time violations — three line-distinct sites covering
+ * both sub-rules and both ways a name becomes secret (annotation, pattern). */
+#include <stdint.h>
+
+/* mochi-ct: secret(k) */
+static void annotated_branch(const uint8_t *k, int n, int *out) {
+    int d = k[0] & 15;
+    if (d) { /* BAD: branch on annotated secret (one level of taint) */
+        *out = n;
+    }
+}
+
+static int named_secret_branch(const uint8_t *priv_key, int n) {
+    int acc = 0;
+    for (int i = 0; i < n; i++) {
+        while (priv_key[i]) { /* BAD: loop condition on pattern-named secret */
+            acc++;
+        }
+    }
+    return acc;
+}
+
+static int secret_index(const uint8_t *nonce, const int *TAB) {
+    int d = nonce[0] & 7;
+    return TAB[d]; /* BAD: table lookup indexed by secret-derived value */
+}
+
+static int secret_leading_index(const uint8_t *nonce, const int (*COMB)[4]) {
+    int d = nonce[0] & 3;
+    return COMB[d][0]; /* BAD: secret in the LEADING dimension of a chain */
+}

@@ -1,0 +1,29 @@
+/* Safe counterparts: the verify path's PUBLIC digit loops and the sign
+ * path's branch-free comb — the contrast the checker's fixture pins. */
+#include <stdint.h>
+
+/* verify path: ns digits derive from the PUBLIC signature/challenge bytes */
+static int public_digits(const uint8_t *sig, const int *TAB, int n) {
+    int acc = 0;
+    for (int i = 0; i < n; i++) {
+        int ns = sig[i] & 15;
+        if (ns) { /* public data — branching is free */
+            acc += TAB[ns];
+        }
+    }
+    return acc;
+}
+
+/* mochi-ct: secret(k) */
+static void branch_free_comb(const uint8_t *k, int *acc) {
+    for (int w = 0; w < 64; w++) {
+        int d = (k[w >> 1] >> ((w & 1) * 4)) & 15;
+        acc[0] += d; /* unconditional arithmetic: no branch, no table */
+    }
+}
+
+/* chained lookup on PUBLIC indices only — both dimensions inspected, clean */
+static int public_chain(const uint8_t *sig, const int (*M)[16]) {
+    int ns = sig[0] & 15;
+    return M[ns][ns & 3];
+}

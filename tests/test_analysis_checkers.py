@@ -28,6 +28,8 @@ BAD_EXPECTATIONS = [
     ("jax-trace-safety", "trace_safety_bad.py", 5),
     ("constant-time", "const_time_bad.py", 4),
     ("protocol-invariants", "invariants_bad.py", 2),
+    ("await-races", "await_races_bad.py", 5),
+    ("native-const-time", "native_ct_bad.c", 4),
 ]
 
 
@@ -51,6 +53,8 @@ def test_bad_fixture_trips_checker(rule, filename, expected):
         ("jax-trace-safety", "trace_safety_good.py"),
         ("constant-time", "const_time_good.py"),
         ("protocol-invariants", "invariants_good.py"),
+        ("await-races", "await_races_good.py"),
+        ("native-const-time", "native_ct_good.c"),
     ],
 )
 def test_good_fixture_is_clean(rule, filename):
@@ -236,3 +240,312 @@ def test_scoping_excludes_fixture_paths():
         [fixture("trace_safety_bad.py")], rules=["jax-trace-safety"], scoped=True
     )
     assert result.new == []
+
+
+# ------------------------------------------------- await-races: tiers & sites
+
+
+def test_await_races_severity_tiers_and_subrules():
+    result = run_rule("await-races", "await_races_bad.py")
+    by_kind = {f.message.split("]")[0].lstrip("["): f for f in result.new}
+    assert set(by_kind) == {
+        "check-then-act", "stale-read", "shared-iter", "tally-authority"
+    }
+    assert by_kind["check-then-act"].severity == "high"
+    assert by_kind["tally-authority"].severity == "high"
+    assert by_kind["stale-read"].severity == "medium"
+    assert by_kind["shared-iter"].severity == "medium"
+    # tier shows in the rendering but NOT in the fingerprint (re-tiering a
+    # rule must not invalidate baselines)
+    assert "/high" in by_kind["check-then-act"].render()
+    from dataclasses import replace
+
+    retiered = replace(by_kind["check-then-act"], severity="advice")
+    assert retiered.fingerprint == by_kind["check-then-act"].fingerprint
+
+
+def test_await_races_constructor_call_does_not_taint_local(tmp_path):
+    # Binding from a call that merely TAKES an element read builds a new
+    # value — the first dry run flagged `self._new_replica(self.config
+    # .servers[k].host)` shapes tree-wide and drowned the real findings.
+    p = tmp_path / "ctor.py"
+    p.write_text(
+        "import asyncio\n"
+        "class C:\n"
+        "    async def f(self, k):\n"
+        "        fresh = self.build(self.servers[k].host)\n"
+        "        await asyncio.sleep(0)\n"
+        "        return fresh\n"
+    )
+    result = core.run([str(p)], rules=["await-races"], scoped=False)
+    assert result.new == [], [f.render() for f in result.new]
+
+
+def test_await_races_slice_of_id_not_tracked(tmp_path):
+    # self.client_id[:8] slices an immutable id — not an element read
+    p = tmp_path / "slice.py"
+    p.write_text(
+        "import asyncio\n"
+        "class C:\n"
+        "    async def f(self):\n"
+        "        tag = [f'{self.client_id[:8]}-{j}' for j in range(4)]\n"
+        "        await asyncio.sleep(0)\n"
+        "        return tag\n"
+    )
+    result = core.run([str(p)], rules=["await-races"], scoped=False)
+    assert result.new == [], [f.render() for f in result.new]
+
+
+def test_await_races_lock_detection_is_word_level(tmp_path):
+    """`with self._lock:` clears a check-then-act; `with self.clock():`
+    and `with self.blocking_io():` must NOT — the substring "lock" inside
+    an unrelated word would silently disable the highest-severity rule
+    for the whole block."""
+    template = (
+        "import asyncio\n"
+        "class C:\n"
+        "    async def f(self, k):\n"
+        "        if k in self.table:\n"
+        "            await asyncio.sleep(0)\n"
+        "            with {ctx}:\n"
+        "                del self.table[k]\n"
+    )
+    for ctx, cleared in (
+        ("self._lock", True),
+        ("self.session_locks[k]", True),
+        ("self.clock()", False),
+        ("self.blocking_io()", False),
+    ):
+        p = tmp_path / "lockcase.py"
+        p.write_text(template.format(ctx=ctx))
+        result = core.run([str(p)], rules=["await-races"], scoped=False)
+        if cleared:
+            assert result.new == [], (ctx, [f.render() for f in result.new])
+        else:
+            assert any(
+                "check-then-act" in f.message for f in result.new
+            ), (ctx, [f.render() for f in result.new])
+
+
+# --------------------------------------------------------- hygiene & native
+
+
+def test_unused_suppression_is_a_finding(tmp_path):
+    p = tmp_path / "stale_supp.py"
+    p.write_text(
+        "import asyncio\n"
+        "# mochi-lint: disable=async-blocking -- nothing here needs this\n"
+        "async def f():\n"
+        "    await asyncio.sleep(0)\n"
+    )
+    result = core.run([str(p)], scoped=False, hygiene=True)
+    assert len(result.new) == 1
+    assert result.new[0].rule == core.HYGIENE_RULE
+    assert "unused suppression" in result.new[0].message
+    # without hygiene the same tree passes (rule-subset runs must not
+    # convict suppressions the skipped checkers could have vindicated)
+    assert core.run([str(p)], scoped=False).new == []
+
+
+def test_stale_baseline_entry_is_a_finding(tmp_path):
+    target = tmp_path / "clean.py"
+    target.write_text("import asyncio\nasync def f():\n    await asyncio.sleep(0)\n")
+    baseline = tmp_path / "baseline.json"
+    import json
+
+    disp = core.display_path(str(target))
+    baseline.write_text(
+        json.dumps({"fingerprints": ["deadbeefdeadbeef"], "paths": [disp]})
+    )
+    result = core.run(
+        [str(target)], scoped=False, baseline=str(baseline), hygiene=True
+    )
+    assert len(result.new) == 1
+    assert result.new[0].rule == core.HYGIENE_RULE
+    assert "stale baseline entry deadbeefdeadbeef" in result.new[0].message
+
+
+def test_stale_baseline_needs_coverage_to_convict(tmp_path):
+    """A partial-path run must NOT convict baseline entries it couldn't
+    have matched (the entry may belong to an unscanned file — convicting
+    it, and the --write-baseline advice in the message, would silently
+    amnesty every unscanned file's grandfathered debt).  Coverage comes
+    from the ``paths`` record --write-baseline stores; a legacy baseline
+    without one never convicts."""
+    import json
+
+    a = tmp_path / "a.py"
+    b = tmp_path / "b.py"
+    for p in (a, b):
+        p.write_text("import asyncio\nasync def f():\n    await asyncio.sleep(0)\n")
+    baseline = tmp_path / "baseline.json"
+    # entry recorded against BOTH files: scanning only b.py is not coverage
+    baseline.write_text(
+        json.dumps(
+            {
+                "fingerprints": ["deadbeefdeadbeef"],
+                "paths": [core.display_path(str(a)), core.display_path(str(b))],
+            }
+        )
+    )
+    partial = core.run(
+        [str(b)], scoped=False, baseline=str(baseline), hygiene=True
+    )
+    assert partial.new == []
+    # legacy baseline (no paths record): staleness is undecidable — silent
+    baseline.write_text(json.dumps({"fingerprints": ["deadbeefdeadbeef"]}))
+    legacy = core.run(
+        [str(a), str(b)], scoped=False, baseline=str(baseline), hygiene=True
+    )
+    assert legacy.new == []
+
+
+def test_write_baseline_records_scanned_paths(tmp_path):
+    target = fixture("async_blocking_bad.py")
+    first = core.run([target], scoped=False)
+    assert first.new
+    baseline_path = tmp_path / "baseline.json"
+    core.write_baseline(str(baseline_path), first.new, scanned=first.scanned)
+    assert core.load_baseline_paths(str(baseline_path)) == set(first.scanned)
+    # the round trip convicts nothing (all entries still match) and a
+    # removed finding WOULD convict: full coverage is satisfied
+    again = core.run(
+        [target], scoped=False, baseline=str(baseline_path), hygiene=True
+    )
+    assert again.new == [] and len(again.baselined) == len(first.new)
+
+
+def test_suppression_justification_does_not_bleed_into_rules(tmp_path):
+    # `disable=<rule> -- why` must suppress <rule>; the prose after the
+    # rule list once bled into the parsed rule names and disabled nothing
+    p = tmp_path / "justified.py"
+    p.write_text(
+        "import time\n"
+        "async def f():\n"
+        "    time.sleep(1)  # mochi-lint: disable=async-blocking -- justified: fixture\n"
+    )
+    result = core.run([str(p)], scoped=False, hygiene=True)
+    assert result.new == [], [f.render() for f in result.new]
+    assert len(result.suppressed) == 1
+
+
+def test_native_hbatch_sign_path_pinned_clean():
+    # The REAL engine is the known-good fixture: ge_mul_base is annotated
+    # `mochi-ct: secret(k)` and must scan clean apart from the one reviewed
+    # comb-table suppression — which must be load-bearing (hygiene would
+    # flag it as unused otherwise).
+    import mochi_tpu
+
+    native = os.path.join(
+        os.path.dirname(mochi_tpu.__file__), "native", "hbatch.c"
+    )
+    result = core.run([native], rules=["native-const-time"], scoped=True)
+    assert result.new == [], [f.render() for f in result.new]
+
+    full = core.run([native], hygiene=True)
+    assert full.new == [], [f.render() for f in full.new]
+    assert len(full.suppressed) == 1  # the BCOMB secret-index site
+
+
+def test_native_ct_compound_assignment_taints(tmp_path):
+    """`d |= k[0]` must taint `d` like `d = k[0]` does — accumulate-into
+    is THE dominant constant-time C idiom, and missing it silently
+    un-flags the secret branch on the accumulator.  Comparisons must not
+    false-taint."""
+    p = tmp_path / "acc.c"
+    p.write_text(
+        "/* mochi-ct: secret(k) */\n"
+        "static int acc(const unsigned char k[32]) {\n"
+        "    int d = 0;\n"
+        "    d |= k[0];\n"
+        "    if (d) { return 1; }\n"
+        "    int clean = 0;\n"
+        "    int cmp = (clean == 0);\n"
+        "    if (cmp) { return 2; }\n"
+        "    return 0;\n"
+        "}\n"
+    )
+    result = core.run([str(p)], rules=["native-const-time"], scoped=False)
+    branch = [f for f in result.new if "secret-branch" in f.message]
+    assert len(branch) == 1, [f.render() for f in result.new]
+    assert branch[0].line == 5  # `if (d)` — not the cmp branch
+
+
+def test_await_races_mutating_call_kwarg_await_is_boundary(tmp_path):
+    """An await inside a KEYWORD argument of a mutating call is a segment
+    boundary like any positional-arg await — skipping it corrupted segment
+    numbering and silently suppressed every sub-rule downstream."""
+    p = tmp_path / "kw.py"
+    p.write_text(
+        "class C:\n"
+        "    async def f(self, k):\n"
+        "        v = self.table[k]\n"
+        "        self.stats.update(extra=await self.fetch())\n"
+        "        return v\n"
+    )
+    result = core.run([str(p)], rules=["await-races"], scoped=False)
+    assert len(result.new) == 1, [f.render() for f in result.new]
+    assert "stale" in result.new[0].message
+    assert result.new[0].line == 5  # the post-await use of `v`
+
+
+def test_await_races_augassign_reads_stale_local(tmp_path):
+    """`n += 1` LOADS n before the store: a tracked element read used this
+    way after an await is exactly the read-modify-write of stale state the
+    rule exists for."""
+    p = tmp_path / "aug.py"
+    p.write_text(
+        "import asyncio\n"
+        "class C:\n"
+        "    async def f(self, k):\n"
+        "        n = self.counts[k]\n"
+        "        await asyncio.sleep(0)\n"
+        "        n += 1\n"
+        "        return n\n"
+    )
+    result = core.run([str(p)], rules=["await-races"], scoped=False)
+    assert len(result.new) == 1, [f.render() for f in result.new]
+    assert "stale" in result.new[0].message
+    assert result.new[0].line == 6  # the augmented load, not the return
+
+
+def test_native_ct_two_line_header_scanned(tmp_path):
+    """A function whose name sits on the line AFTER its return type (the
+    GNU/kernel style) must scan like a single-line header — it used to
+    bypass the checker entirely."""
+    p = tmp_path / "two.c"
+    p.write_text(
+        "/* mochi-ct: secret(k) */\n"
+        "static void\n"
+        "two_line(const unsigned char k[32], unsigned char *out) {\n"
+        "    if (k[0]) {\n"
+        "        out[0] = 1;\n"
+        "    }\n"
+        "}\n"
+    )
+    result = core.run([str(p)], rules=["native-const-time"], scoped=False)
+    branch = [f for f in result.new if "secret-branch" in f.message]
+    assert len(branch) == 1, [f.render() for f in result.new]
+    assert branch[0].line == 4
+
+
+def test_native_hbatch_checker_not_vacuous(tmp_path):
+    # Strip the reviewed suppression from the real file: the comb-table
+    # lookup must then flag — proving the annotation + taint actually
+    # reach the hot site (the pin isn't a scope accident).
+    import mochi_tpu
+
+    native = os.path.join(
+        os.path.dirname(mochi_tpu.__file__), "native", "hbatch.c"
+    )
+    src = open(native).read()
+    stripped = "\n".join(
+        ln for ln in src.splitlines() if "mochi-lint" not in ln
+    )
+    tree = tmp_path / "native"
+    tree.mkdir()
+    (tree / "hbatch.c").write_text(stripped)
+    result = core.run([str(tree / "hbatch.c")], rules=["native-const-time"], scoped=False)
+    assert len(result.new) == 1, [f.render() for f in result.new]
+    assert "BCOMB" in result.new[0].snippet
+    assert result.new[0].severity == "advice"

@@ -65,6 +65,33 @@ def test_session_table_never_evicts_pinned_entry():
     assert "busy" in t2 and "stale" not in t2
 
 
+def test_session_table_policy_evict_defers_on_pinned():
+    """The safe eviction hook (replica.evict_client seam): an unpinned
+    sender drops immediately; a pinned (mid-batch) one is deferred to its
+    final unpin so in-flight responses still seal; a fresh handshake
+    supersedes a pending deferred drop (the ban book, not eviction timing,
+    keeps an evicted client out)."""
+    t = SessionTable(max_entries=4, ttl_s=0)
+    t["idle"] = b"k1"
+    assert t.evict("idle") == "evicted" and "idle" not in t
+    assert t.evict("idle") == "absent"
+    t["busy"] = b"k2"
+    t.pin("busy")
+    t.pin("busy")  # nested pin: two envelopes of one drain
+    assert t.evict("busy") == "deferred"
+    assert t.get("busy") == b"k2"  # still live mid-batch
+    t.unpin("busy")
+    assert "busy" in t  # first unpin: still one pin outstanding
+    t.unpin("busy")
+    assert "busy" not in t and t.evictions == 2  # dropped at FINAL unpin
+    t["back"] = b"k3"
+    t.pin("back")
+    assert t.evict("back") == "deferred"
+    t["back"] = b"k4"  # re-handshake while pinned clears the deferral
+    t.unpin("back")
+    assert t.get("back") == b"k4"
+
+
 def test_replica_session_pinned_across_batch_await():
     """End-to-end pin: a MAC'd request mid-batch must keep its session
     alive even when a same-batch handshake lands in a full table — the

@@ -117,3 +117,43 @@ def test_key_derivation_is_directional_and_nonce_bound():
     tag = session_crypto.mac(k_ab, b"payload")
     assert session_crypto.mac_ok(k_ba, b"payload", tag)
     assert not session_crypto.mac_ok(k_ba, b"payload2", tag)
+
+
+def test_evict_client_bans_rehandshake_but_keeps_signed_path():
+    """The policy-eviction hook (replica.evict_client, the ROADMAP item-4
+    disconnect-policy seam): the MAC session drops, re-handshakes are
+    refused with a TYPED signed failure, and the client transparently
+    stays on signed envelopes — eviction bounds the MAC discount, it does
+    not (yet) refuse service."""
+
+    async def main():
+        async with VirtualCluster(4, rf=4) as vc:
+            client = vc.client()
+            await client.execute_write_transaction(
+                TransactionBuilder().write("ek", b"v1").build()
+            )
+            r0 = vc.replica("server-0")
+            assert client.client_id in r0._sessions
+            out = r0.evict_client(client.client_id)
+            assert out["session"] == "evicted" and out["banned"] is True
+            assert out["outstanding_grants"] == 0  # committed write: no debt
+            assert client.client_id not in r0._sessions
+            assert r0.client_grant_stats()["banned_clients"] == 1
+            # drop the client's own half so the next fan-out re-knocks
+            client._sessions.pop("server-0", None)
+            # next write: server-0 refuses the handshake (typed, signed,
+            # BAD_REQUEST — must NOT trip the lost-session teardown) and the
+            # fan-out still commits over signed envelopes
+            await client.execute_write_transaction(
+                TransactionBuilder().write("ek", b"v2").build()
+            )
+            assert client.client_id not in r0._sessions  # ban held
+            assert r0.metrics.counters.get("replica.handshake-banned", 0) >= 1
+            r = await client.execute_read_transaction(
+                TransactionBuilder().read("ek").build()
+            )
+            assert r.operations[0].value == b"v2"
+            # idempotent re-evict: session already gone, ban already booked
+            assert r0.evict_client(client.client_id)["session"] == "absent"
+
+    run(main())
