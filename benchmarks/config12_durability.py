@@ -1,0 +1,431 @@
+"""Config 12: durability — SIGKILL recovery, priced and adversarially audited.
+
+Rounds 1-13 ran the paper's posture: every byte of cluster state in memory,
+so the benchmark story ended at the first process restart.  Round 14's
+``mochi_tpu/storage`` engine (self-certifying WAL + snapshots + verified
+replay + delta anti-entropy) makes kill-and-recover-with-state a measurable
+scenario, and this config measures all four of its claims:
+
+* **zero acked-write loss across a real SIGKILL** — a 4-process
+  ProcessCluster under live load, EVERY replica killed mid-stream (no
+  drain, no snapshot; the only durability is the flush-before-ack WAL
+  append), restarted from disk, and every acknowledged write read back;
+  per-replica recovery stats scraped over the admin surface;
+* **recovery-time-vs-store-size curve** — verified replay (every logged
+  certificate's grants re-verify through the batch signature path) timed
+  at >= 3 store sizes on one growing cluster;
+* **tampered-log conviction** — three Byzantine-restart legs (mutated
+  certificate value, forged grant signatures, reordered records): each
+  must be convicted with per-entry attribution and the tampered state
+  never served (InvariantChecker invariant 5);
+* **fsync-policy cost table** — the same write workload under
+  ``MOCHI_WAL_FSYNC=always|group|off`` and the in-memory baseline: what
+  each durability level costs at the ack path.
+
+The headline value is the largest curve point's replay time — the number
+an operator needs for "how long is a replica down after a crash?".
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import shutil
+import time
+import urllib.request
+from typing import Dict, List, Optional
+
+from .config7_wan import _pcts
+
+
+# ------------------------------------------------ SIGKILL -> recover leg
+
+
+async def _kill_recover_leg(min_acked: int, timeout_s: float) -> Dict:
+    from mochi_tpu.client.txn import TransactionBuilder
+    from mochi_tpu.testing.process_cluster import ProcessCluster, _free_tcp_ports
+
+    admin_base = _free_tcp_ports(1)[0]
+    async with ProcessCluster(
+        4, rf=4, n_processes=4, storage_dir=True, wal_fsync="group",
+        admin_base_port=admin_base,
+    ) as pc:
+        client = pc.client(timeout_s=timeout_s)
+        acked: Dict[str, bytes] = {}
+
+        async def load():
+            i = 0
+            while True:
+                key, value = f"dk{i}", b"v%d" % i
+                try:
+                    await client.execute_write_transaction(
+                        TransactionBuilder().write(key, value).build()
+                    )
+                except Exception:
+                    return  # in flight at the kill: indeterminate
+                acked[key] = value
+                i += 1
+
+        writer = asyncio.ensure_future(load())
+        while len(acked) < min_acked:
+            await asyncio.sleep(0.02)
+        kill_at = len(acked)
+        for i in range(4):
+            pc.kill_replica(f"server-{i}")
+        await writer
+        await client.close()
+
+        t0 = time.perf_counter()
+        for i in range(4):
+            await pc.restart_replica(f"server-{i}")
+        restart_wall_ms = (time.perf_counter() - t0) * 1e3
+
+        reader = pc.client(timeout_s=timeout_s)
+        lost: List[str] = []
+        t0 = time.perf_counter()
+        for key, value in sorted(acked.items()):
+            res = await reader.execute_read_transaction(
+                TransactionBuilder().read(key).build()
+            )
+            if res.operations[0].value != value:
+                lost.append(key)
+        readback_ms = (time.perf_counter() - t0) * 1e3
+        pc.check_alive()
+
+        # per-replica recovery evidence over the admin surface (the same
+        # /status "storage" key an operator's dashboard reads)
+        loop = asyncio.get_running_loop()
+        replay = {}
+        for pi in range(4):
+            port = admin_base + pi * 4
+            try:
+                raw = await loop.run_in_executor(
+                    None,
+                    lambda p=port: urllib.request.urlopen(
+                        f"http://127.0.0.1:{p}/status", timeout=5
+                    ).read(),
+                )
+                st = json.loads(raw)["storage"]
+                replay[f"server-{pi}"] = {
+                    "entries": st["replay"]["entries"],
+                    "convicted": st["replay"]["convicted"],
+                    "ms": st["replay"]["ms"],
+                    "torn_tail": st["replay"]["torn_tail"],
+                }
+            except Exception as exc:  # admin scrape is evidence, not a gate
+                replay[f"server-{pi}"] = {"error": f"{type(exc).__name__}"}
+    return {
+        "acked_before_kill": kill_at,
+        "acked_total": len(acked),
+        "lost": len(lost),
+        "lost_keys": lost[:8],
+        "restart_wall_ms": round(restart_wall_ms, 1),
+        "readback_ms": round(readback_ms, 1),
+        "replay_per_replica": replay,
+    }
+
+
+# ------------------------------------------------- recovery curve + delta
+
+
+async def _recovery_curve(
+    sizes, gap_writes: int, timeout_s: float
+) -> Dict:
+    """One growing durable VirtualCluster: restart + time verified replay
+    at each cumulative size; at the largest size also run the delta-resync
+    leg (writes committed while the victim is down must ship as deltas)."""
+    import tempfile
+
+    from mochi_tpu.client.txn import TransactionBuilder
+    from mochi_tpu.testing.virtual_cluster import VirtualCluster
+
+    points: List[Dict] = []
+    delta_evidence: Optional[Dict] = None
+    with tempfile.TemporaryDirectory() as td:
+        async with VirtualCluster(4, rf=4, storage_dir=td) as vc:
+            client = vc.client(timeout_s=timeout_s)
+            written = 0
+            for target in sorted(sizes):
+                while written < target:
+                    await client.execute_write_transaction(
+                        TransactionBuilder()
+                        .write(f"cv{written}", b"v%d" % written)
+                        .build()
+                    )
+                    written += 1
+                last = target == max(sizes)
+                # Freeze the victim's LIVE disk state (WAL, no snapshot):
+                # the graceful restart below would otherwise snapshot +
+                # truncate on close, and the point of the curve is the
+                # crash shape — full verified WAL replay.
+                victim = vc.replica("server-1")
+                await victim.storage.flush()
+                frozen = os.path.join(td, "server-1") + ".crash"
+                shutil.copytree(os.path.join(td, "server-1"), frozen)
+
+                async def crash_then_gap(sid, frozen=frozen, last=last):
+                    dst = os.path.join(td, sid)
+                    shutil.rmtree(dst)
+                    shutil.move(frozen, dst)
+                    for g in range(gap_writes if last else 0):
+                        await client.execute_write_transaction(
+                            TransactionBuilder()
+                            .write(f"gap{g}", b"late")
+                            .build()
+                        )
+
+                fresh = await vc.restart_replica(
+                    "server-1", resync=True, before_boot=crash_then_gap
+                )
+                report = fresh.storage.replay_report()
+                points.append(
+                    {
+                        "keys": target,
+                        "replay_entries": report["entries"],
+                        "replay_ms": report["ms"],
+                        "convicted": report["convicted"],
+                    }
+                )
+                if last:
+                    ae = fresh.storage_stats()["anti_entropy"]
+                    delta_evidence = {
+                        "gap_writes": gap_writes,
+                        "shards_matched": ae["shards_matched"],
+                        "keys_matched": ae["keys_matched"],
+                        "delta_keys_pulled": ae["delta_keys_pulled"],
+                        "full_keys_pulled": ae["full_keys_pulled"],
+                        "digest_pages": ae["digest_pages"],
+                    }
+    return {"points": points, "delta_resync": delta_evidence}
+
+
+# ------------------------------------------------------ tamper conviction
+
+
+def _rewrite_last_segment(directory: str, server_id: str, mutate) -> None:
+    from mochi_tpu.storage import wal
+
+    _index, path = wal.list_segments(directory)[-1]
+    with open(path, "rb") as fh:
+        data = fh.read()
+    start = wal.read_segment_header(data, server_id)
+    scan = wal.scan_segment(data, server_id)
+    records = [[r.seq, r.rtype, r.body] for r in scan.records]
+    mutate(records)
+    with open(path, "wb") as fh:
+        fh.write(
+            data[:start]
+            + b"".join(wal.encode_record(s, t, b) for s, t, b in records)
+        )
+
+
+async def _tamper_leg(timeout_s: float) -> Dict:
+    """Three Byzantine restarts on one cluster: each victim's frozen
+    mid-life disk state is adversarially rewritten (correct CRCs — the
+    framing is not the defense) and the verified replay must convict."""
+    import tempfile
+
+    from mochi_tpu.client.txn import TransactionBuilder
+    from mochi_tpu.testing.invariants import InvariantChecker
+    from mochi_tpu.testing.virtual_cluster import VirtualCluster
+
+    def last_data_commit(records):
+        from mochi_tpu.storage import wal
+
+        for rec in reversed(records):
+            if rec[1] == wal.RT_COMMIT and rec[2][0][0].startswith("tp"):
+                return rec
+        raise AssertionError("no data commit in segment")
+
+    def mutate_value(records):
+        last_data_commit(records)[2][1][0][2] = b"EVIL"
+
+    def forge_sigs(records):
+        for mg_obj in last_data_commit(records)[2][2].values():
+            mg_obj[3] = b"\x00" * 64
+
+    def reorder(records):
+        records[-1], records[-2] = records[-2], records[-1]
+
+    legs = (
+        ("mutated_value", "server-1", mutate_value),
+        ("forged_grant_sigs", "server-2", forge_sigs),
+        ("reordered_records", "server-3", reorder),
+    )
+    out: Dict[str, Dict] = {}
+    with tempfile.TemporaryDirectory() as td:
+        async with VirtualCluster(4, rf=4, storage_dir=td) as vc:
+            client = vc.client(timeout_s=timeout_s)
+            for i in range(10):
+                await client.execute_write_transaction(
+                    TransactionBuilder().write(f"tp{i}", b"v%d" % i).build()
+                )
+            for name, sid, mutate in legs:
+                victim = vc.replica(sid)
+                await victim.storage.flush()
+                frozen = os.path.join(td, sid) + ".crash"
+                shutil.copytree(os.path.join(td, sid), frozen)
+                _rewrite_last_segment(frozen, sid, mutate)
+
+                def restore(s, frozen=frozen):
+                    dst = os.path.join(td, s)
+                    shutil.rmtree(dst)
+                    shutil.move(frozen, dst)
+
+                fresh = await vc.restart_replica(sid, before_boot=restore)
+                report = fresh.storage.replay_report()
+                served_evil = any(
+                    sv is not None and sv.value == b"EVIL"
+                    for sv in (fresh.store._get(f"tp{i}") for i in range(10))
+                )
+                checker = InvariantChecker([fresh])
+                checker.check_now()
+                crep = checker.report()
+                out[name] = {
+                    "convicted": report["convicted"],
+                    "convictions": report["convictions"][:4],
+                    "tampered_state_served": served_evil,
+                    "invariants_ok": crep["ok"],
+                    "checker_convictions": crep["storage_replay_convictions"],
+                }
+    return out
+
+
+# ------------------------------------------------- fsync-policy cost table
+
+
+async def _fsync_leg(
+    policy: Optional[str], n_writes: int, timeout_s: float
+) -> Dict:
+    """One write workload under one fsync policy (None = the in-memory
+    engine: the durability-overhead baseline)."""
+    import tempfile
+
+    from mochi_tpu.client.txn import TransactionBuilder
+    from mochi_tpu.testing.virtual_cluster import VirtualCluster
+
+    prev = os.environ.get("MOCHI_WAL_FSYNC")
+    if policy is not None:
+        os.environ["MOCHI_WAL_FSYNC"] = policy
+    try:
+        with tempfile.TemporaryDirectory() as td:
+            async with VirtualCluster(
+                4, rf=4, storage_dir=(td if policy is not None else None)
+            ) as vc:
+                client = vc.client(timeout_s=timeout_s)
+                lat: List[float] = []
+                for i in range(n_writes):
+                    t0 = time.perf_counter()
+                    await client.execute_write_transaction(
+                        TransactionBuilder().write(f"fp{i}", b"x" * 64).build()
+                    )
+                    lat.append(time.perf_counter() - t0)  # _pcts wants s
+                fsyncs = wal_entries = 0
+                for r in vc.replicas:
+                    st = r.storage.stats()
+                    fsyncs += st.get("fsyncs", 0)
+                    wal_entries += st.get("wal_entries", 0)
+        return {
+            "write_ms": _pcts(lat),
+            "writes": n_writes,
+            "fsyncs_total": fsyncs,
+            "wal_entries_total": wal_entries,
+        }
+    finally:
+        if policy is not None:
+            if prev is None:
+                os.environ.pop("MOCHI_WAL_FSYNC", None)
+            else:
+                os.environ["MOCHI_WAL_FSYNC"] = prev
+
+
+# ------------------------------------------------------------------- run
+
+
+def run(
+    min_acked: int = 40,
+    curve_sizes=(64, 256, 1024),
+    gap_writes: int = 6,
+    fsync_policies=("always", "group", "off"),
+    fsync_writes: int = 60,
+    timeout_s: float = 8.0,
+) -> Dict:
+    from mochi_tpu.utils.runtime import tune_gc_for_server
+
+    tune_gc_for_server()
+
+    kill = asyncio.run(_kill_recover_leg(min_acked, timeout_s))
+    curve = asyncio.run(_recovery_curve(curve_sizes, gap_writes, timeout_s))
+    tamper = asyncio.run(_tamper_leg(timeout_s))
+    fsync_cost: Dict[str, Dict] = {
+        "memory-baseline": asyncio.run(
+            _fsync_leg(None, fsync_writes, timeout_s)
+        )
+    }
+    for policy in fsync_policies:
+        fsync_cost[policy] = asyncio.run(
+            _fsync_leg(policy, fsync_writes, timeout_s)
+        )
+    base_p50 = fsync_cost["memory-baseline"]["write_ms"]["p50"]
+    for policy in fsync_policies:
+        p50 = fsync_cost[policy]["write_ms"]["p50"]
+        fsync_cost[policy]["write_p50_vs_memory"] = (
+            round(p50 / base_p50, 3) if base_p50 and p50 == p50 else None
+        )
+
+    delta = curve["delta_resync"] or {}
+    acceptance = {
+        "zero_acked_write_loss": kill["lost"] == 0,
+        "tamper_convicted_all_legs": all(
+            leg["convicted"] >= 1 and not leg["tampered_state_served"]
+            and leg["invariants_ok"]
+            for leg in tamper.values()
+        ),
+        "resync_after_recovery_ships_deltas": bool(
+            delta.get("delta_keys_pulled", 0) > 0
+            and delta.get("full_keys_pulled", 1) == 0
+        ),
+        "replay_convictions_zero_on_honest_logs": all(
+            p["convicted"] == 0 for p in curve["points"]
+        ),
+    }
+    top = curve["points"][-1] if curve["points"] else {}
+    return {
+        "metric": "durable_recovery_replay_ms",
+        "value": top.get("replay_ms"),
+        "unit": (
+            f"ms of verified replay to recover {top.get('keys')} keys "
+            "(snapshot-less worst case; grants re-verified via the batch "
+            "signature path)"
+        ),
+        "acceptance": acceptance,
+        "topology": {
+            "replicas": 4,
+            "rf": 4,
+            "f": 1,
+            "kill_leg": "ProcessCluster, 4 processes, SIGKILL all mid-load",
+            "wal_fsync_kill_leg": "group",
+            "client_timeout_s": timeout_s,
+        },
+        "kill_recover": kill,
+        "recovery_curve": curve["points"],
+        "delta_resync": curve["delta_resync"],
+        "tamper": tamper,
+        "fsync_policy_cost": fsync_cost,
+        "notes": (
+            "kill leg: acked = client saw the Write2 quorum answer; the "
+            "flush-before-ack WAL append (group policy: OS page cache) is "
+            "the only durability a SIGKILL leaves, so lost=0 is the "
+            "round-14 contract.  Curve points restore a frozen mid-life disk "
+            "image (WAL only, no snapshot) before each restart — the "
+            "snapshot-less worst case; an operator with default "
+            "snapshotting replays only the post-snapshot tail.  Tamper "
+            "legs rewrite the log with CORRECT CRCs: conviction comes "
+            "from certificate re-verification, not framing."
+        ),
+    }
+
+
+if __name__ == "__main__":
+    print(json.dumps(run(), indent=2))

@@ -33,6 +33,7 @@ CONFIG_NAMES = {
     "9": "config9_overload",
     "10": "config10_byzantine",
     "11": "config11_byzclient",
+    "12": "config12_durability",
 }
 
 # --smoke: tiny-count kwargs per config — a seconds-scale pass whose only
@@ -82,6 +83,16 @@ SMOKE_KWARGS = {
         n_clients=1, keys_per_client=2, sweeps=1, attacks=("withhold",),
         timeout_s=1.0, ttl_ms=300.0, wedge_trials=1, wedge_ttl_ms=300.0,
         wedge_deadline_s=2.0, wedge_seeds=24, wedge_seeds_cost=16,
+    ),
+    # the whole durability surface in seconds: one real-process SIGKILL ->
+    # restart -> readback pass (the children run the real engines), a
+    # 2-point recovery curve, all three tamper-conviction legs, and one
+    # fsync policy vs the memory baseline — curve/latency numbers at these
+    # counts are noise; the record schema + acceptance booleans are what
+    # smoke pins
+    "12": dict(
+        min_acked=6, curve_sizes=(6, 12), gap_writes=2,
+        fsync_policies=("group",), fsync_writes=6, timeout_s=4.0,
     ),
 }
 

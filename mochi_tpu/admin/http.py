@@ -45,6 +45,8 @@ quorum={quorum} &middot; {member}</p>
 <table><tr><th>server</th><th>endpoint</th></tr>{member_rows}</table>
 <h2>Store</h2>
 <table>{store_rows}</table>
+<h2>Storage</h2>
+<table>{storage_rows}</table>
 <h2>Shard</h2>
 <table>{shard_rows}</table>
 <h2>Verifier</h2>
@@ -322,6 +324,37 @@ def _clients_prom(replica) -> str:
     return "".join(lines)
 
 
+def _storage_rows(replica) -> str:
+    """The "/" page Storage table (docs/OPERATIONS.md §4i): durable-engine
+    counters — WAL bytes/entries/segments, fsync policy + count, snapshot
+    age, replay report — plus the anti-entropy delta-vs-full transfer
+    accounting, one row per leaf.  The in-memory default renders just the
+    engine posture row."""
+    st = replica.storage_stats()
+    rows = {k: st[k] for k in ("engine", "fsync", "dir") if k in st}
+    leaves: list = []
+    _walk_numeric("", st, leaves)
+    rows.update(dict(leaves))
+    return _rows(rows)
+
+
+def _storage_prom(replica) -> str:
+    """``mochi_storage{stat,server}`` exposition: every numeric leaf of
+    storage_stats (wal bytes/entries, fsyncs, snapshot age/seq, replay
+    progress + convictions, anti-entropy delta counters).  The fsync
+    latency histogram rides the registry's own exposition as
+    ``storage-fsync-ms``."""
+    samples: list = []
+    _walk_numeric("", replica.storage_stats(), samples)
+    if not samples:
+        return ""
+    sid = _prom_esc(replica.server_id)
+    return "# TYPE mochi_storage gauge\n" + "".join(
+        f'mochi_storage{{stat="{k}",server="{sid}"}} {v}\n'
+        for k, v in samples
+    )
+
+
 def _overload_rows(replica) -> str:
     """The "/" page Overload table: admission-control state and bounded-
     table sizes, flattened to one row per numeric leaf."""
@@ -442,6 +475,11 @@ class AdminServer(HttpJsonServer):
                         "servers": {s.server_id: s.url for s in cfg.servers.values()},
                     },
                     "store": r.store.stats(),
+                    # durable-storage engine counters + replay report +
+                    # anti-entropy transfer accounting (engine "memory"
+                    # when running the reference's in-memory posture —
+                    # docs/OPERATIONS.md §4i)
+                    "storage": r.storage_stats(),
                     # Token-ring ownership + per-phase owned/foreign traffic
                     # (the shard-per-core scale-out observable: foreign
                     # counters at ~0 mean client routing matches the ring —
@@ -512,6 +550,11 @@ class AdminServer(HttpJsonServer):
                 )
             body += _fanout_prom(r.metrics, "server", r.server_id)
             body += _byzantine_prom(r)
+            # Durable-storage gauges: mochi_storage{stat} — WAL growth,
+            # fsync count, snapshot age, replay progress/convictions and
+            # the anti-entropy delta counters in one stat-labeled family
+            # (docs/OPERATIONS.md §4i).
+            body += _storage_prom(r)
             # Per-client grant accounting: mochi_client{client,stat} —
             # "is any client hoarding or being reclaimed?" is one query.
             body += _clients_prom(r)
@@ -571,6 +614,7 @@ class AdminServer(HttpJsonServer):
                 member="member" if r.server_id in cfg.servers else "NOT A MEMBER",
                 member_rows=member_rows,
                 store_rows=_rows(r.store.stats()),
+                storage_rows=_storage_rows(r),
                 shard_rows=_rows(r.store.shard_stats()),
                 verifier_rows=_rows(verifier_stats(r.verifier)),
                 batching_rows=_batching_rows(r.metrics),

@@ -551,6 +551,78 @@ class SyncEntriesFromServer:
 
 
 @dataclass(frozen=True)
+class SyncDigestRequestToServer:
+    """Anti-entropy digest pull (round 14: incremental state transfer).
+
+    Full resync used to ship every (transaction, certificate) pair the
+    peer held — megabytes to learn "you already match".  This message
+    pair makes the exchange proportional to the DIFFERENCE instead, two
+    granularities over one request type:
+
+    * ``tokens=None`` — SHARD level: the peer rolls every token-ring
+      shard it holds committed state for into ``(token, n_keys,
+      digest)`` where ``digest`` XORs the per-key digests (order
+      independent, so two replicas that applied the same commits in any
+      order agree).  One small page covers the whole ring.
+    * ``tokens=(...)`` — KEY level for exactly those shards: pages of
+      ``(key, digest16)`` so the puller can name the differing keys.
+
+    Digests are derived from the last committed transaction hash — the
+    same hash the 2f+1 grant quorum signed — so a lying digest can at
+    worst cause a redundant pull or a skipped pull of state the peer
+    could not prove anyway; the actual transfer stays the certificate-
+    validated ``SyncRequestToServer`` path.
+    """
+
+    tokens: Optional[Tuple[int, ...]] = None
+    max_entries: int = 4096
+    after_key: Optional[str] = None
+
+    def to_obj(self) -> Any:
+        return [
+            list(self.tokens) if self.tokens is not None else None,
+            self.max_entries,
+            self.after_key,
+        ]
+
+    @classmethod
+    def from_obj(cls, obj: Any) -> "SyncDigestRequestToServer":
+        tokens, max_entries, after_key = obj
+        return cls(
+            tuple(int(t) for t in tokens) if tokens is not None else None,
+            max_entries,
+            after_key,
+        )
+
+
+@dataclass(frozen=True)
+class SyncDigestFromServer:
+    """Digest page: shard rollups (``tokens=None`` requests) or per-key
+    digests (shard-targeted requests).  Exactly one of the two is set."""
+
+    shards: Optional[Tuple[Tuple[int, int, bytes], ...]] = None
+    keys: Optional[Tuple[Tuple[str, bytes], ...]] = None
+
+    def to_obj(self) -> Any:
+        return [
+            [list(s) for s in self.shards] if self.shards is not None else None,
+            [list(k) for k in self.keys] if self.keys is not None else None,
+        ]
+
+    @classmethod
+    def from_obj(cls, obj: Any) -> "SyncDigestFromServer":
+        shards, keys = obj
+        return cls(
+            tuple((int(t), int(n), bytes(d)) for t, n, d in shards)
+            if shards is not None
+            else None,
+            tuple((str(k), bytes(d)) for k, d in keys)
+            if keys is not None
+            else None,
+        )
+
+
+@dataclass(frozen=True)
 class NudgeSyncToServer:
     """Client hint: your grants for these keys lag the quorum — resync.
     Advisory only (the replica pulls and re-validates from its peers)."""
@@ -676,6 +748,8 @@ _PAYLOAD_TYPES: Tuple[Type, ...] = (
     VerifyBitmapFromServer,
     SessionInitToServer,
     SessionAckFromServer,
+    SyncDigestRequestToServer,  # appended: existing wire tags stay stable
+    SyncDigestFromServer,
 )
 _TAG_BY_TYPE = {cls: i for i, cls in enumerate(_PAYLOAD_TYPES)}
 

@@ -128,6 +128,16 @@ async def amain(args) -> None:
         snapshot_path = None
         if args.data_dir:
             snapshot_path = str(Path(args.data_dir) / f"{sid}.snapshot")
+        storage = None
+        if args.storage_dir:
+            # Log-structured durable engine (docs/OPERATIONS.md §4i): WAL +
+            # snapshots under <storage-dir>/<sid>; boot recovery replays
+            # through the verified path before READY is printed.
+            from ..storage import build_storage
+
+            storage = build_storage(
+                args.storage_dir, sid, fsync=args.wal_fsync
+            )
         replica_cls = MochiReplica
         replica_kwargs = {}
         if sid in byzantine:
@@ -151,6 +161,7 @@ async def amain(args) -> None:
             port=info.port,
             snapshot_path=snapshot_path,
             snapshot_interval_s=args.snapshot_interval,
+            storage=storage,
             # explicit --admission wins; the deprecated --shed-lag-ms alias
             # only applies when the new flag was not passed; default on
             admission=(
@@ -264,7 +275,25 @@ def main(argv=None) -> None:
         "--snapshot-interval",
         type=float,
         default=30.0,
-        help="seconds between periodic snapshots (with --data-dir)",
+        help="seconds between periodic snapshots (with --data-dir or "
+        "--storage-dir)",
+    )
+    parser.add_argument(
+        "--storage-dir",
+        default=None,
+        help="durable log-structured storage root (WAL + snapshots + "
+        "verified crash recovery under <dir>/<server-id>; "
+        "docs/OPERATIONS.md §4i).  Orthogonal to --data-dir's legacy "
+        "whole-store snapshots",
+    )
+    parser.add_argument(
+        "--wal-fsync",
+        choices=("always", "group", "off"),
+        default=None,
+        help="WAL durability policy (default: MOCHI_WAL_FSYNC or 'group'): "
+        "always = fsync before every ack (group-committed); group = ack "
+        "after the OS write (SIGKILL-safe), fsync on a background tick; "
+        "off = no fsync outside snapshot/close",
     )
     parser.add_argument(
         "--resync-on-boot",

@@ -56,33 +56,50 @@ def _sv_from_obj(obj) -> StoreValue:
     )
 
 
-def snapshot_bytes(store: DataStore) -> bytes:
-    """Serialize committed state (grants excluded by design)."""
-    return encode(
-        {
-            "magic": MAGIC,
-            "version": VERSION,
-            "server_id": store.server_id,
-            "data": [_sv_to_obj(sv) for sv in store.data.values()],
-            "data_config": [_sv_to_obj(sv) for sv in store.data_config.values()],
-        }
-    )
+def snapshot_bytes(store: DataStore, extra: Optional[dict] = None) -> bytes:
+    """Serialize committed state (grants excluded by design).
+
+    ``extra`` merges additional top-level keys into the document — the
+    durable engine stamps its WAL watermark (``wal_seq``) here so recovery
+    knows which log records the snapshot already covers.  Unknown keys are
+    ignored by :func:`load_snapshot_bytes`, so the wire format stays
+    version-1 compatible in both directions.
+    """
+    doc = {
+        "magic": MAGIC,
+        "version": VERSION,
+        "server_id": store.server_id,
+        "data": [_sv_to_obj(sv) for sv in store.data.values()],
+        "data_config": [_sv_to_obj(sv) for sv in store.data_config.values()],
+    }
+    if extra:
+        for k, v in extra.items():
+            doc.setdefault(k, v)
+    return encode(doc)
 
 
-def load_snapshot_bytes(store: DataStore, blob: bytes) -> int:
-    """Populate an (empty) store from snapshot bytes; returns object count."""
+def read_snapshot_doc(blob: bytes, server_id: str) -> dict:
+    """Decode + validate a snapshot document without touching any store
+    (the durable engine replays entries through the verified Write2 path
+    instead of raw-installing them)."""
     doc = decode(blob)
     if doc.get("magic") != MAGIC:
         raise ValueError("not a mochi-tpu snapshot")
     if doc.get("version") != VERSION:
         raise ValueError(f"unsupported snapshot version {doc.get('version')}")
-    if doc.get("server_id") != store.server_id:
+    if doc.get("server_id") != server_id:
         # A snapshot carries one replica's epochs and ownership view; loading
         # another server's (shared data dir, restore mix-up) would serve
         # wrong shards at wrong epochs.
         raise ValueError(
-            f"snapshot belongs to {doc.get('server_id')!r}, not {store.server_id!r}"
+            f"snapshot belongs to {doc.get('server_id')!r}, not {server_id!r}"
         )
+    return doc
+
+
+def load_snapshot_bytes(store: DataStore, blob: bytes) -> int:
+    """Populate an (empty) store from snapshot bytes; returns object count."""
+    doc = read_snapshot_doc(blob, store.server_id)
     n = 0
     for obj in doc["data"]:
         sv = _sv_from_obj(obj)

@@ -29,6 +29,7 @@ from typing import Dict, Iterable, List, Optional, Sequence
 from ..cluster.config import (
     CONFIG_CLIENT_PREFIX,
     CONFIG_CLUSTER_KEY,
+    SHARD_TOKENS,
     ClusterConfig,
     config_client_key,
 )
@@ -48,6 +49,8 @@ from ..protocol import (
     SessionInitToServer,
     Status,
     SyncAckFromServer,
+    SyncDigestFromServer,
+    SyncDigestRequestToServer,
     SyncEntriesFromServer,
     SyncRequestToServer,
     Write1OkFromServer,
@@ -113,6 +116,13 @@ class MochiReplica:
         admission: Optional[bool] = None,
         shed_lag_ms: Optional[float] = None,
         netsim=None,
+        # Durable storage (round 14, mochi_tpu/storage; docs/OPERATIONS.md
+        # §4i): ``storage`` takes a ready StorageEngine; ``storage_dir``
+        # builds a DurableStorage rooted at <dir>/<server_id> (WAL +
+        # snapshots + verified crash recovery).  Neither -> MemoryStorage,
+        # the reference's in-memory posture and the test-matrix default.
+        storage=None,
+        storage_dir: Optional[str] = None,
     ):
         self.server_id = server_id
         self.config = config
@@ -122,6 +132,22 @@ class MochiReplica:
         self.require_client_auth = require_client_auth
         self.store = DataStore(server_id, config)
         self.metrics = Metrics()
+        # Storage SPI: the store stages durable events into the engine
+        # synchronously; this replica awaits the engine's flush at the
+        # batched-write2 seam (acks only after the log write) and runs
+        # recovery at boot.  Safe to attach before recovery: the durable
+        # engine's stage hooks no-op while it is replaying.
+        if storage is None:
+            from ..storage import build_storage
+
+            storage = build_storage(storage_dir, server_id, metrics=self.metrics)
+        elif getattr(storage, "metrics", None) is None:
+            # an engine built before the replica existed (server boot path)
+            # adopts this replica's registry for its fsync/snapshot evidence
+            storage.metrics = self.metrics
+        self.storage = storage
+        self.store.storage = storage
+        storage.store = self.store  # bg snapshot trigger needs the store
         # Batched hot path: the transport drains each scheduling tick's
         # frames (across all connections) into the two batch entry points —
         # MAC'd read/write1/hello synchronously, everything else through
@@ -210,7 +236,10 @@ class MochiReplica:
         if self.snapshot_path:
             from . import persistence
 
-            n = persistence.load_snapshot(self.store, self.snapshot_path)
+            def _load():
+                return persistence.load_snapshot(self.store, self.snapshot_path)
+
+            n = await asyncio.get_running_loop().run_in_executor(None, _load)
             if n:
                 self.metrics.mark("replica.snapshot-loaded", n)
             # A snapshot may hold a newer committed membership than the boot
@@ -218,8 +247,26 @@ class MochiReplica:
             sv = self.store._get(CONFIG_CLUSTER_KEY)
             if sv is not None and sv.exists and sv.value:
                 self._install_config(sv.value)
+        # Durable-storage recovery BEFORE the socket opens: replay the
+        # snapshot + WAL through the verified path (every certificate's
+        # grants re-verify on this replica's own batch verifier — a
+        # tampered log is convicted, never served).  Config installs fire
+        # through the store's apply hook exactly as live traffic does.
+        report = await self.storage.recover(
+            self.store, verifier=self.verifier, metrics=self.metrics
+        )
+        if report.get("entries") or report.get("convicted"):
+            LOG.info(
+                "storage recovery for %s: %s entries replayed, %s convicted "
+                "(%s ms)",
+                self.server_id, report.get("entries"),
+                report.get("convicted"), report.get("ms"),
+            )
+        await self.storage.start()
         await self.rpc.start()
-        if self.snapshot_path and self.snapshot_interval_s > 0:
+        if self.snapshot_interval_s > 0 and (
+            self.snapshot_path or self.storage.name == "durable"
+        ):
             self._snapshot_task = asyncio.ensure_future(self._snapshot_loop())
 
     @staticmethod
@@ -274,6 +321,19 @@ class MochiReplica:
 
         while True:
             await asyncio.sleep(self.snapshot_interval_s)
+            if self.storage.name == "durable":
+                try:
+                    # the engine snapshots + truncates its own WAL (and
+                    # also self-triggers on log growth); the legacy
+                    # snapshot_path mechanism below stays for callers
+                    # without a storage engine
+                    await self.storage.snapshot(self.store)
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    LOG.exception("storage snapshot failed")
+                if not self.snapshot_path:
+                    continue
             try:
                 # Serialize ON the event loop (the store mutates only there —
                 # snapshotting from a thread would race dict iteration and
@@ -332,6 +392,14 @@ class MochiReplica:
                 LOG.exception("final snapshot failed")
         await self.peer_pool.close()
         await self.rpc.close()
+        # After the socket is down nothing new can stage: final flush +
+        # snapshot + log truncation, so the next boot replays a short tail.
+        try:
+            await self.storage.close(self.store)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            LOG.exception("storage close failed")
 
     @property
     def bound_port(self) -> int:
@@ -781,6 +849,15 @@ class MochiReplica:
         if w2_reqs:
             with metrics.timer("replica.write2"):
                 results = self.store.process_write2_batch(w2_reqs)
+            if self.storage.dirty:
+                # Durability BEFORE acknowledgement: the batch's staged
+                # commit records hit the log (to the engine's fsync-policy
+                # level) before any Write2 answer is built — group commit
+                # at exactly the batching seam, so one flush covers the
+                # whole drained batch.  The no-storage default short-
+                # circuits on ``dirty`` (False) with zero awaits.
+                with metrics.timer("replica.wal-flush"):
+                    await self.storage.flush()
             for i, env, result in zip(w2_idx, w2_envs, results):
                 if isinstance(result, Exception):
                     LOG.error("write2 failed for %s", env.msg_id, exc_info=result)
@@ -874,6 +951,35 @@ class MochiReplica:
                 payload.prefix,
             )
             return self._respond(env, SyncEntriesFromServer(tuple(entries)))
+        if isinstance(payload, SyncDigestRequestToServer):
+            # Anti-entropy digest page (round 14): shard rollups or per-key
+            # digests, so a resyncing peer names the DIFFERENCE before
+            # pulling.  Digests derive from quorum-signed transaction
+            # hashes; the transfer itself stays the certificate-validated
+            # SyncRequestToServer path, so lying here buys nothing.
+            metrics.mark("replica.sync-digest-requests")
+            if payload.tokens is None:
+                return self._respond(
+                    env,
+                    SyncDigestFromServer(
+                        shards=tuple(
+                            (t, n, d)
+                            for t, n, d in self.store.export_shard_digests()
+                        )
+                    ),
+                )
+            return self._respond(
+                env,
+                SyncDigestFromServer(
+                    keys=tuple(
+                        self.store.export_key_digests(
+                            payload.tokens[:SHARD_TOKENS],
+                            min(payload.max_entries, 4096),
+                            payload.after_key,
+                        )
+                    )
+                ),
+            )
         if isinstance(payload, NudgeSyncToServer):
             # Advisory lag hint (paper's client-initiated UptoSpeed,
             # mochiDB.tex:168-169): queue the keys for the single
@@ -1142,7 +1248,10 @@ class MochiReplica:
         advanced_keys: set = set()
 
         async def pull_peer(
-            info, prefix: Optional[str], req_keys: "Optional[tuple]" = None
+            info,
+            prefix: Optional[str],
+            req_keys: "Optional[tuple]" = None,
+            count: Optional[str] = None,
         ) -> None:
             after: Optional[str] = None
             while True:  # page until a short page (or error/foreign payload)
@@ -1160,6 +1269,10 @@ class MochiReplica:
                 if not isinstance(res.payload, SyncEntriesFromServer):
                     return
                 entries = res.payload.entries
+                if count is not None and entries:
+                    # delta-vs-full transfer accounting (the round-14
+                    # incremental anti-entropy evidence on storage_stats)
+                    self.metrics.mark(f"replica.resync-{count}-keys", len(entries))
                 for entry in entries:
                     if not self.store.owns(entry.key):
                         continue
@@ -1172,6 +1285,91 @@ class MochiReplica:
                 if len(entries) < page:
                     return
                 after = entries[-1].key
+
+        async def digest_page(info, request) -> Optional[SyncDigestFromServer]:
+            try:
+                res = await self.peer_pool.send_and_receive(
+                    info, self._signed_request(request), timeout_s
+                )
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                return None
+            if not isinstance(res.payload, SyncDigestFromServer):
+                return None  # pre-round-14 peer (or refusal): caller falls back
+            return res.payload
+
+        async def pull_peer_delta(info) -> None:
+            """Incremental anti-entropy (round 14): shard digests -> key
+            digests for mismatched shards -> pull ONLY the differing keys.
+            Peers that do not speak digests get the old full pull.  Digest
+            comparisons are advisory (a lying peer causes a redundant or
+            missed pull from ITSELF only); every transferred entry still
+            re-validates through the Write2 path."""
+            res = await digest_page(info, SyncDigestRequestToServer())
+            if res is None or res.shards is None:
+                await pull_peer(info, None, None, count="full")
+                return
+            local_shards = {
+                t: (n, d) for t, n, d in self.store.export_shard_digests()
+            }
+            matched = 0
+            mismatched: List[int] = []
+            for token, n, digest in res.shards:
+                if not 0 <= token < SHARD_TOKENS:
+                    continue
+                if self.server_id not in self.config.replica_set_for_token(token):
+                    continue  # none of its keys are ours to apply
+                have = local_shards.get(token)
+                # compare_digest not for secrecy (digests derive from
+                # public quorum-signed hashes) but uniformity: every
+                # authenticator-shaped compare in this module is constant
+                # time, so the const-time pass stays exception-free
+                if have is not None and have[0] == n and hmac.compare_digest(
+                    have[1], digest
+                ):
+                    matched += 1
+                else:
+                    mismatched.append(token)
+            if matched:
+                self.metrics.mark("replica.resync-shards-matched", matched)
+            if not mismatched:
+                return
+            wanted = set(mismatched)
+            local_keys = {
+                key: d
+                for key, token, d in self.store._iter_digests()
+                if token in wanted
+            }
+            delta: List[str] = []
+            keys_matched = 0
+            after: Optional[str] = None
+            while True:
+                res = await digest_page(
+                    info,
+                    SyncDigestRequestToServer(
+                        tokens=tuple(mismatched), max_entries=4096, after_key=after
+                    ),
+                )
+                if res is None or res.keys is None:
+                    return
+                self.metrics.mark("replica.resync-digest-pages")
+                for key, digest in res.keys:
+                    if not self.store.owns(key):
+                        continue
+                    if hmac.compare_digest(local_keys.get(key, b""), digest):
+                        keys_matched += 1
+                    else:
+                        delta.append(key)
+                if len(res.keys) < 4096:
+                    break
+                after = res.keys[-1][0]
+            if keys_matched:
+                self.metrics.mark("replica.resync-keys-matched", keys_matched)
+            for i in range(0, len(delta), page):
+                await pull_peer(
+                    info, None, tuple(delta[i : i + page]), count="delta"
+                )
 
         with self.metrics.timer("replica.resync"):
             # Pass 1 (x2): the _CONFIG_ keyspace alone — historical config
@@ -1198,10 +1396,24 @@ class MochiReplica:
                         *(pull_peer(info, CONFIG_KEY_PREFIX, None) for info in peers)
                     )
             # Pass 2: the requested keys (config keys re-apply as no-ops).
-            await asyncio.gather(*(pull_peer(info, None, key_tuple) for info in peers))
+            # A FULL resync (keys=None) goes digest-first — per-shard
+            # rollups, then per-key digests for mismatched shards, then a
+            # pull of only the difference — so a recovered-from-disk
+            # replica ships deltas instead of the whole store; targeted
+            # resyncs already name their keys.
+            if key_tuple is None:
+                await asyncio.gather(*(pull_peer_delta(info) for info in peers))
+            else:
+                await asyncio.gather(
+                    *(pull_peer(info, None, key_tuple) for info in peers)
+                )
         if advanced_keys:
             LOG.info("resync advanced %d objects", len(advanced_keys))
             self.metrics.mark("replica.resync-applied", len(advanced_keys))
+        if self.storage.dirty:
+            # resync applies stage commits like any other Write2: make the
+            # pulled state durable before reporting it recovered
+            await self.storage.flush()
         return len(advanced_keys)
 
     def _prepare_certificate(self, wc: WriteCertificate, defer_own: bool = False) -> tuple:
@@ -1368,6 +1580,24 @@ class MochiReplica:
             "banned": client_id in self._client_bans,
             "outstanding_grants": 0 if ledger is None else ledger["outstanding"],
         }
+
+    def storage_stats(self) -> Dict[str, object]:
+        """The /status "storage" surface (admin/http.py; docs/OPERATIONS.md
+        §4i): engine counters (WAL bytes/entries, fsync policy + count,
+        snapshot age, replay report) plus this replica's anti-entropy
+        transfer accounting (how much state moved as DELTAS vs full pulls
+        during resync — the round-14 incremental state-transfer evidence)."""
+        st = self.storage.stats()
+        c = self.metrics.counters
+        st["anti_entropy"] = {
+            "digest_pages": c.get("replica.resync-digest-pages", 0),
+            "shards_matched": c.get("replica.resync-shards-matched", 0),
+            "keys_matched": c.get("replica.resync-keys-matched", 0),
+            "delta_keys_pulled": c.get("replica.resync-delta-keys", 0),
+            "full_keys_pulled": c.get("replica.resync-full-keys", 0),
+            "applied": c.get("replica.resync-applied", 0),
+        }
+        return st
 
     def byzantine_stats(self) -> Dict[str, object]:
         """Per-peer misbehavior evidence for the admin surfaces (/status

@@ -306,6 +306,13 @@ class DataStore:
         # max_open_wedge_ms on the admin surfaces).
         self._wedge_start: Dict[str, Tuple[float, str]] = {}
         self.max_wedge_ms = 0.0
+        # Storage SPI (round 14, mochi_tpu/storage): every durable event —
+        # applied transactions (self-certifying (keys, txn, certificate)
+        # triples) and reclaim epoch bumps — is STAGED synchronously here;
+        # the replica awaits the engine's flush at the batched-write2 seam
+        # before acknowledging.  None/MemoryStorage = the reference's
+        # in-memory posture (the default for the test matrix).
+        self.storage = None  # Optional[mochi_tpu.storage.StorageEngine]
 
     def shard_stats(self) -> Dict[str, int]:
         """Token-ring ownership summary + per-phase owned/foreign counters.
@@ -406,6 +413,16 @@ class DataStore:
         sv.delete_grant(ts)
         for dts in sv.advance_epoch(ts):
             self._untrack_grant(key, dts)
+        if self.storage is not None:
+            # The one epoch event a commit cannot reconstruct: recovering
+            # without it could re-grant the reclaimed slot (the safety
+            # argument's "never re-granted" promise must survive restarts).
+            self.storage.stage_reclaim(
+                key,
+                ts,
+                existing.transaction_hash if existing is not None else b"",
+                sv.current_epoch,
+            )
 
     def _sweep_expired_grants(self, client_id: str, now: float) -> int:
         """Expiry sweep for ONE client's aged grants, run when its quota
@@ -951,6 +968,8 @@ class DataStore:
 
         results: List[OperationResult] = []
         staleness_checked: Dict[str, bool] = {}
+        already_current: Dict[str, bool] = {}
+        applied: Dict[str, None] = {}  # insertion-ordered applied-key set
         for op in transaction.operations:
             if not self.owns(op.key):
                 self.shard_counters["write2_foreign"] += 1
@@ -986,6 +1005,19 @@ class DataStore:
                 current_ts = self._cert_ts(sv)
                 stale = current_ts is not None and current_ts > ts
                 staleness_checked[op.key] = stale
+                # Equal-ts re-apply of the SAME transaction (a client
+                # Write2 retry, a resync pull of an already-current key):
+                # the apply below is an idempotent no-op, so staging it
+                # would write a duplicate WAL record that the next
+                # recovery's "did not advance" rule falsely convicts as
+                # tampering.  Judged per key against the PRE-transaction
+                # state, like staleness.
+                already_current[op.key] = (
+                    not stale
+                    and current_ts == ts
+                    and sv.last_transaction is not None
+                    and transaction_hash(sv.last_transaction) == txn_hash
+                )
             if stale:
                 # Stale write2: answer with current state instead
                 # (ref: InMemoryDataStore.java:594-598).
@@ -993,7 +1025,24 @@ class DataStore:
             else:
                 result = self._apply(op, sv, ts, req.write_certificate, transaction)
                 self.shard_counters["write2_applied"] += 1
+                if op.action in (Action.WRITE, Action.DELETE) and not (
+                    already_current.get(op.key)
+                ):
+                    # READ ops inside a write transaction commit nothing,
+                    # and already-current keys re-commit nothing: staging
+                    # either would make replay (which re-runs the whole
+                    # transaction) see a no-op and convict an honest log
+                    # for it.
+                    applied.setdefault(op.key)
             results.append(result)
+        if applied and self.storage is not None:
+            # ONE staged record per applied transaction (the engine's
+            # replay applies the whole transaction in one Write2, exactly
+            # like this call did) — staged synchronously on this loop
+            # turn; the replica flushes before the batch's responses ship.
+            self.storage.stage_commit(
+                list(applied), transaction, req.write_certificate
+            )
         return Write2AnsFromServer(TransactionResult(tuple(results)), rid="")
 
     def process_write2_batch(
@@ -1116,6 +1165,58 @@ class DataStore:
                 continue
             out.append(SyncEntry(key, sv.last_transaction, sv.current_certificate))
         return out
+
+    @staticmethod
+    def key_digest(key: str, txh: bytes) -> bytes:
+        """16-byte anti-entropy digest of one key's last commit.  Derived
+        from the quorum-signed transaction hash, so two honest replicas
+        that applied the same commit agree byte-for-byte."""
+        import hashlib
+
+        return hashlib.sha256(key.encode() + b"\x00" + txh).digest()[:16]
+
+    def _iter_digests(self):
+        """(key, token, digest16) for every key with commit history, both
+        keyspaces (the ``_CONFIG_`` keys hash onto the ring like any other
+        key, so they roll into shard digests uniformly)."""
+        for space in (self.data, self.data_config):
+            for key, sv in space.items():
+                if sv.last_transaction is None or sv.current_certificate is None:
+                    continue
+                txh = transaction_hash(sv.last_transaction)
+                yield key, self.config.token_for_key(key), self.key_digest(key, txh)
+
+    def export_shard_digests(self) -> List[List[object]]:
+        """Per-shard rollups ``[token, n_keys, digest]`` — the XOR of the
+        shard's per-key digests (order independent: replicas that applied
+        the same commits in any interleaving agree).  Shards with no
+        committed state are omitted (an empty shard XORs to the absent
+        entry on both sides)."""
+        acc: Dict[int, List[object]] = {}
+        for _key, token, digest in self._iter_digests():
+            slot = acc.get(token)
+            if slot is None:
+                acc[token] = [token, 1, digest]
+            else:
+                slot[1] += 1
+                slot[2] = bytes(a ^ b for a, b in zip(slot[2], digest))
+        return [acc[t] for t in sorted(acc)]
+
+    def export_key_digests(
+        self,
+        tokens: Iterable[int],
+        max_entries: int = 4096,
+        after_key: Optional[str] = None,
+    ) -> List[Tuple[str, bytes]]:
+        """Key-level digests for the named shards, key-sorted pages (same
+        ``after_key`` protocol as :meth:`export_sync_entries`)."""
+        wanted = set(tokens)
+        out = sorted(
+            (key, digest)
+            for key, token, digest in self._iter_digests()
+            if token in wanted and (after_key is None or key > after_key)
+        )
+        return out[:max_entries]
 
     def apply_sync_entry(self, entry: SyncEntry) -> bool:
         """Apply one state-transfer entry through the full Write2 validation

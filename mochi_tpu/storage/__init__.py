@@ -1,0 +1,19 @@
+"""Durable storage engines behind the :class:`StorageEngine` SPI.
+
+``MemoryStorage`` (default) keeps the reference's in-process posture;
+``DurableStorage`` is the round-14 log-structured engine: CRC-framed WAL
+of self-certifying write certificates, group-commit fsync policies,
+snapshots with log truncation, and crash recovery that re-verifies every
+replayed certificate through the batch signature path (tampered logs are
+convicted, never adopted).  See docs/OPERATIONS.md §4i.
+"""
+
+from .durable import DurableStorage
+from .spi import MemoryStorage, StorageEngine, build_storage
+
+__all__ = [
+    "StorageEngine",
+    "MemoryStorage",
+    "DurableStorage",
+    "build_storage",
+]

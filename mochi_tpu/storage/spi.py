@@ -1,0 +1,118 @@
+"""Storage SPI: the seam ``DataStore`` persists through.
+
+The datastore stays what it always was — the in-memory protocol state
+machine (dicts, single loop turn, no locks).  What changed in round 14 is
+that every DURABLE event now flows through one narrow interface so the
+engine behind it is swappable:
+
+* ``stage_commit(keys, transaction, certificate)`` — called synchronously
+  from the store's apply path, ONCE per applied transaction (``keys`` =
+  the distinct keys it applied on this replica).  The staged triple is the
+  protocol's own self-certifying evidence (2f+1 signed grants), which is
+  the whole structural trick: a log of these IS its own proof, so replay
+  re-verifies instead of trusting the disk.
+* ``stage_reclaim(key, ts, granted_hash, new_epoch)`` — the one epoch
+  event commits cannot reconstruct (a reclaim bumps an epoch with no
+  commit; recovering without it could re-grant a promised-never slot).
+* ``flush()`` — awaited by the replica at the batched-write2 seam BEFORE
+  responses go out: an acknowledged write is on disk (to the policy's
+  durability level) by the time the client sees the ack.
+
+Engines:
+
+* :class:`MemoryStorage` — the default: state lives and dies with the
+  process, exactly the reference's posture (and the right one for the
+  in-process test matrix).  Every hook is a no-op.
+* :class:`~mochi_tpu.storage.durable.DurableStorage` — the log-structured
+  engine (WAL + snapshots + verified recovery), opted into via
+  ``MochiReplica(storage_dir=...)`` / ``--storage-dir``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+
+class StorageEngine:
+    """Interface + the shared no-op defaults.
+
+    All ``stage_*`` hooks are synchronous and must stay cheap: they run
+    inside the store's uninterrupted batch loop turn.  All IO happens in
+    the async half (``flush``/``snapshot``/``recover``/``close``), which
+    engines run through executors — the replica's event loop never blocks
+    on a file (the PR-1 async-blocking rule).
+    """
+
+    name = "none"
+
+    # ------------------------------------------------------------- staging
+
+    def stage_commit(self, keys: List[str], transaction, certificate) -> None:
+        pass
+
+    def stage_reclaim(
+        self, key: str, ts: int, granted_hash: bytes, new_epoch: int
+    ) -> None:
+        pass
+
+    @property
+    def dirty(self) -> bool:
+        """Anything staged or written-but-not-yet-durable."""
+        return False
+
+    # ------------------------------------------------------------ lifecycle
+
+    async def start(self) -> None:
+        pass
+
+    async def flush(self) -> None:
+        pass
+
+    async def snapshot(self, store) -> None:
+        pass
+
+    async def recover(self, store, verifier=None, metrics=None) -> Dict:
+        """Rebuild ``store`` from disk; returns the replay report."""
+        return {"entries": 0, "convicted": 0}
+
+    async def close(self, store=None) -> None:
+        pass
+
+    # --------------------------------------------------------------- admin
+
+    def stats(self) -> Dict[str, object]:
+        return {"engine": self.name}
+
+    def replay_report(self) -> Dict[str, object]:
+        return {"entries": 0, "convicted": 0, "convictions": []}
+
+    @property
+    def convictions(self) -> List[Dict[str, object]]:
+        return []
+
+
+class MemoryStorage(StorageEngine):
+    """Explicit no-op engine (the default posture, reference-equivalent)."""
+
+    name = "memory"
+
+
+def build_storage(
+    storage_dir: Optional[str],
+    server_id: str,
+    fsync: Optional[str] = None,
+    metrics=None,
+) -> StorageEngine:
+    """``storage_dir`` -> a DurableStorage rooted at ``<dir>/<server_id>``
+    (per-replica isolation under one operator-supplied root); None -> the
+    in-memory no-op."""
+    if not storage_dir:
+        return MemoryStorage()
+    import os
+
+    from .durable import DurableStorage
+
+    return DurableStorage(
+        os.path.join(storage_dir, server_id), server_id, fsync=fsync,
+        metrics=metrics,
+    )

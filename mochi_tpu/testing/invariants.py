@@ -36,6 +36,17 @@ latency cost:
    legally occupy the same (key, ts) a laggard reclaimed — that
    coexistence is not a violation.
 
+5. **Durable-replay integrity** (round 14, ``mochi_tpu/storage``) — a
+   replica recovered from disk never silently serves tampered log state:
+   every conviction its replay verifier attributed (forged grant
+   signature, reordered sequence, torn non-final segment, rejected
+   snapshot entry) is surfaced per entry in the report, and for the
+   conviction classes where the entry was REFUSED adoption outright the
+   checker asserts the replica's live store is not serving the convicted
+   transaction (adoption-refused state showing up anyway would mean the
+   replay verifier was bypassed).  Convictions themselves are the system
+   WORKING — they count as evidence, not violations.
+
 The checker never looks inside Byzantine replicas: the invariants
 constrain what the HONEST side of the cluster may do while <= f members
 behave arbitrarily.
@@ -75,6 +86,12 @@ class InvariantChecker:
         # (key, ts) slots already convicted under invariant 4 — one
         # conviction per slot, not one per sample.
         self._reclaim_convicted: set = set()
+        # (server_id, seq, reason) replay convictions already accounted
+        # under invariant 5 — sampled once, not once per tick.
+        self._storage_convicted: set = set()
+        # per-replica replay-conviction evidence for the report (the
+        # tamper-attribution record the config-12 benchmark publishes)
+        self.storage_convictions: Dict[str, List[Dict]] = {}
         # key -> latest acked value (None = acked delete): invariant 3.
         self.acked: Dict[str, Optional[bytes]] = {}
         self.acked_writes = 0
@@ -193,6 +210,45 @@ class InvariantChecker:
                         )
                         break
 
+        # Invariant 5: durable-replay integrity.  Conviction reasons where
+        # the replay verifier REFUSED adoption outright — the convicted
+        # transaction must therefore never show up in the live store (a
+        # duplicate/stale "did not advance" conviction is excluded: its
+        # transaction IS legitimately served via the earlier honest apply).
+        _REFUSED = ("signature", "reorder", "torn non-final", "rejected",
+                    "undecodable", "unknown record")
+        for replica in self.replicas:
+            storage = getattr(replica, "storage", None)
+            if storage is None:
+                continue
+            for conv in storage.convictions:
+                sid = replica.server_id
+                mark = (sid, conv.get("seq"), conv.get("reason"), conv.get("key"))
+                if mark in self._storage_convicted:
+                    continue
+                self._storage_convicted.add(mark)
+                bucket = self.storage_convictions.setdefault(sid, [])
+                if len(bucket) < 64:
+                    bucket.append(dict(conv))
+                reason = str(conv.get("reason") or "")
+                key, txh = conv.get("key"), conv.get("txh")
+                if (
+                    key is None
+                    or not txh
+                    or not any(tag in reason for tag in _REFUSED)
+                ):
+                    continue
+                sv = replica.store._get(key)
+                if sv is not None and sv.last_transaction is not None:
+                    served = transaction_hash(sv.last_transaction).hex()
+                    if served.startswith(str(txh)):
+                        self._violate(
+                            f"replay-convicted entry for {key!r} "
+                            f"(seq={conv.get('seq')}, {reason}) is being "
+                            f"SERVED at {sid}: the replay verifier was "
+                            f"bypassed"
+                        )
+
     async def _loop(self, interval_s: float) -> None:
         while True:
             await asyncio.sleep(interval_s)
@@ -284,5 +340,14 @@ class InvariantChecker:
             "byzantine_replicas": self.byzantine_ids,
             "max_wedge_ms": round(max_wedge_ms, 2),
             "grant_reclaims": reclaims,
+            # invariant 5 evidence: per-replica replay convictions (the
+            # tampered-WAL attribution the config-12 benchmark publishes)
+            "storage_replay_convictions": sum(
+                len(v) for v in self.storage_convictions.values()
+            ),
+            "storage_convictions": {
+                sid: list(entries)
+                for sid, entries in sorted(self.storage_convictions.items())
+            },
             "violations": list(self.violations),
         }

@@ -72,6 +72,9 @@ class _ServerProcess:
         self.proc: Optional[asyncio.subprocess.Process] = None
         self.returncode: Optional[int] = None
         self._pump_task: Optional[asyncio.Task] = None
+        # full spawn argv, kept so restart_replica can re-launch this exact
+        # posture (same ids, same --storage-dir, same knobs) after a kill
+        self.argv: List[str] = []
 
     @property
     def pid(self) -> Optional[int]:
@@ -134,6 +137,15 @@ class ProcessCluster:
         # ``--byzantine sid=strategy`` (testing/byzantine.py catalog) —
         # the cross-process twin of VirtualCluster(byzantine=...).
         byzantine: Optional[Dict[str, str]] = None,
+        # Durable storage across the REAL process boundary (round 14):
+        # True roots a per-replica WAL+snapshot engine inside the cluster
+        # tmpdir (lives exactly as long as the cluster — the kill/restart
+        # window this exists for); a string roots it at that path.
+        # ``kill_replica`` + ``restart_replica`` preserve it, so
+        # SIGKILL-mid-load -> restart -> recover-from-disk runs against
+        # real processes.  ``wal_fsync`` forwards --wal-fsync.
+        storage_dir=None,
+        wal_fsync: Optional[str] = None,
     ):
         if n_processes is None:
             n_processes = min(n_servers, os.cpu_count() or 1)
@@ -153,7 +165,12 @@ class ProcessCluster:
         self.drain_timeout_s = drain_timeout_s
         self.pin_cores = pin_cores
         self.byzantine: Dict[str, str] = dict(byzantine or {})
+        self.storage_dir = storage_dir
+        self.wal_fsync = wal_fsync
+        # resolved at start(): True -> <tmpdir>/storage, str -> that path
+        self.storage_root: Optional[str] = None
         self._extra_env = dict(env or {})
+        self._spawn_env: Optional[Dict[str, str]] = None
         self.config: Optional[ClusterConfig] = None
         self.keypairs: Dict[str, KeyPair] = {}
         self.processes: List[_ServerProcess] = []
@@ -225,6 +242,13 @@ class ProcessCluster:
             # single-owner TPU plugin.
             env.setdefault("JAX_PLATFORMS", "cpu")
         env.update(self._extra_env)
+        self._spawn_env = env
+        if self.storage_dir:
+            self.storage_root = (
+                self.storage_dir
+                if isinstance(self.storage_dir, str)
+                else os.path.join(out, "storage")
+            )
 
         # Round-robin replica -> process assignment: any transaction's
         # replica set (a contiguous ring window) spans processes, so the
@@ -269,6 +293,11 @@ class ProcessCluster:
                     argv += ["--admin-port", str(self.admin_base_port + pi * self.n_servers)]
                 if self.data_dir:
                     argv += ["--data-dir", self.data_dir]
+                if self.storage_root:
+                    argv += ["--storage-dir", self.storage_root]
+                    if self.wal_fsync:
+                        argv += ["--wal-fsync", self.wal_fsync]
+                sp.argv = argv
                 log = await loop.run_in_executor(None, open, sp.log_path, "ab")
                 try:
                     sp.proc = await asyncio.create_subprocess_exec(
@@ -365,6 +394,44 @@ class ProcessCluster:
         assert sp.proc is not None
         sp.proc.send_signal(sig)
         return sp.proc.pid
+
+    async def restart_replica(self, server_id: str) -> None:
+        """Re-launch the (killed or exited) process hosting ``server_id``
+        with its EXACT original argv — same ids, same ``--storage-dir``,
+        same knobs — and block until every hosted replica reprints READY.
+        With a durable ``storage_dir`` the child recovers its committed
+        state from its own WAL + snapshot before READY (verified replay);
+        without one it boots empty, the posture the resync protocol covers.
+        The cross-process twin of ``VirtualCluster.restart_replica``."""
+        sp = self.host_process[server_id]
+        assert sp.proc is not None and sp.argv, "cluster not started"
+        if sp.proc.returncode is None:
+            raise RuntimeError(
+                f"process {sp.index} (hosting {sp.server_ids}) is still "
+                "alive; kill_replica() first"
+            )
+        await self._reap([sp])  # collect the corpse + stop its pump
+        loop = asyncio.get_running_loop()
+        # mochi-lint: disable=await-races -- sp is identity-stable: host_process is written once in start() and cleared only in close(); the reap cannot remap which process hosts server_id
+        log = await loop.run_in_executor(None, open, sp.log_path, "ab")
+        try:
+            sp.proc = await asyncio.create_subprocess_exec(
+                *sp.argv, env=self._spawn_env,
+                stdout=asyncio.subprocess.PIPE, stderr=log,
+            )
+        finally:
+            log.close()
+        sp.returncode = None
+        if self.pin_cores and hasattr(os, "sched_setaffinity"):
+            try:
+                os.sched_setaffinity(
+                    sp.proc.pid, {sp.index % (os.cpu_count() or 1)}
+                )
+            except OSError:
+                pass
+        await asyncio.wait_for(
+            self._wait_ready(sp), timeout=self.ready_timeout_s
+        )
 
     def cpu_seconds(self) -> Dict[str, float]:
         """Per-process CPU (utime+stime) of the live children, keyed

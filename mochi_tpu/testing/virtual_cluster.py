@@ -63,6 +63,12 @@ class VirtualCluster:
         # into its batch seams — and KEEP the strategy across
         # restart_replica (an adversary does not reform on reboot).
         byzantine: Optional[Dict[str, object]] = None,
+        # Durable storage (round 14): every replica gets a DurableStorage
+        # engine rooted at <storage_dir>/<server_id> (WAL + snapshots +
+        # verified recovery), and restart_replica then recovers REAL state
+        # from disk instead of booting empty.  None (default): in-memory,
+        # exactly the reference's posture.
+        storage_dir: Optional[str] = None,
     ):
         self.n_servers = n_servers
         self.rf = rf
@@ -74,6 +80,7 @@ class VirtualCluster:
         self.admission = admission
         self.netsim = netsim
         self.byzantine: Dict[str, object] = dict(byzantine or {})
+        self.storage_dir = storage_dir
         # Unix-domain sockets instead of loopback TCP (per-replica socket
         # files under this dir): skips the TCP/IP stack on the kernel send
         # path, the measured cost floor for single-host clusters
@@ -175,6 +182,7 @@ class VirtualCluster:
             host=host,
             port=port,
             netsim=self.netsim,
+            storage_dir=self.storage_dir,
             **kwargs,
         )
         strategy = self.byzantine.get(sid)
@@ -221,15 +229,33 @@ class VirtualCluster:
     def replica(self, server_id: str) -> MochiReplica:
         return next(r for r in self.replicas if r.server_id == server_id)
 
-    async def restart_replica(self, server_id: str, resync: bool = False) -> MochiReplica:
-        """Kill a replica and boot a fresh one on the same port with EMPTY
-        state (storage is in-memory, as in the reference) — the crash-recovery
-        scenario the resync protocol exists for."""
+    async def restart_replica(
+        self, server_id: str, resync: bool = False, before_boot=None
+    ) -> MochiReplica:
+        """Kill a replica and boot a fresh one on the same port.  Without
+        ``storage_dir`` the fresh replica starts EMPTY (in-memory, as in
+        the reference) — the scenario the resync protocol exists for; with
+        it, boot recovers the replica's committed state from its WAL +
+        snapshot (verified replay), and ``resync=True`` then only ships
+        the DELTA written since the crash (the round-14 incremental
+        anti-entropy path).
+
+        ``before_boot`` (sync or async callable, given ``server_id``) runs
+        in the window after the old replica is down and before the fresh
+        one boots: the seam where crash tests tamper with or restore
+        on-disk storage state, and where delta-resync tests commit the
+        writes the victim must catch up on."""
         old = self.replica(server_id)
         port = old.bound_port
         if old.verifier is not None:
             await old.verifier.close()
         await old.close()
+        if before_boot is not None:
+            import inspect
+
+            result = before_boot(server_id)
+            if inspect.isawaitable(result):
+                await result
         # same endpoint the config advertises (UDS path or TCP host); a
         # byzantine-mapped server comes back byzantine (fresh strategy state)
         fresh = self._new_replica(

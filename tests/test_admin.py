@@ -73,6 +73,13 @@ async def _main():
             if replica.server_id in replica.config.replica_set_for_key("adm-key"):
                 me = cl["per_client"].get(client.client_id, {})
                 assert me.get("issued", 0) >= 1, cl["per_client"]
+            # durable-storage surface (round 14, docs §4i): the key is
+            # present in EVERY posture — the in-memory default reports
+            # engine "memory" with zeroed anti-entropy accounting
+            st = doc["storage"]
+            assert st["engine"] == "memory"
+            assert st["anti_entropy"]["delta_keys_pulled"] == 0
+            assert st["anti_entropy"]["full_keys_pulled"] == 0
 
             status, _, body = await loop.run_in_executor(None, _get, port, "/metrics")
             assert status == 200
@@ -92,6 +99,8 @@ async def _main():
             # one row per tracked identity
             assert 'mochi_client{client="",stat="reclaims"' in body
             assert 'mochi_client{client="",stat="quota"' in body
+            # storage gauges ride one stat-labeled family in every posture
+            assert 'mochi_storage{stat="anti_entropy.delta_keys_pulled"' in body
             if replica.server_id in replica.config.replica_set_for_key("adm-key"):
                 assert f'mochi_client{{client="{client.client_id}"' in body
             # every sample line: name{labels} value
@@ -113,8 +122,73 @@ async def _main():
             assert "Overload" in body and "shed_p" in body
             # the round-13 Clients table: quota knobs + wedge metric rows
             assert "Clients" in body and "max_wedge_ms" in body
+            # the round-14 Storage table: engine posture row at minimum
+            assert "Storage" in body and "engine" in body
         finally:
             await admin.close()
+
+
+def test_admin_storage_surfaces_durable():
+    """Round-14 satellite pin: a durable-engine replica's /status "storage"
+    key, the ``mochi_storage{stat=...}`` prom family (WAL growth, fsync
+    count, snapshot age, replay progress, anti-entropy deltas), the fsync
+    latency histogram, and the "/" page Storage table."""
+
+    async def body(td):
+        async with VirtualCluster(4, rf=4, storage_dir=td) as vc:
+            client = vc.client()
+            for i in range(8):
+                await client.execute_write_transaction(
+                    TransactionBuilder().write(f"adm-st-{i}", b"v").build()
+                )
+            replica = vc.replicas[0]
+            # a deterministic snapshot so snapshot_age_s/seq are live
+            await replica.storage.snapshot(replica.store)
+            admin = AdminServer(replica, port=0)
+            await admin.start()
+            try:
+                port = admin.bound_port
+                loop = asyncio.get_running_loop()
+                _, _, raw = await loop.run_in_executor(None, _get, port, "/status")
+                st = json.loads(raw)["storage"]
+                assert st["engine"] == "durable"
+                assert st["fsync"] == "always"
+                assert st["wal_entries"] >= 8
+                assert st["wal_bytes"] > 0
+                assert st["fsyncs"] >= 1
+                assert st["snapshots"] >= 1
+                assert st["snapshot_age_s"] is not None
+                assert st["replay"]["convicted"] == 0
+                assert "anti_entropy" in st
+                _, _, prom = await loop.run_in_executor(
+                    None, _get, port, "/metrics.prom"
+                )
+                for stat in (
+                    "wal_entries", "wal_bytes", "fsyncs", "snapshots",
+                    "snapshot_age_s", "replay.entries", "replay.convicted",
+                    "anti_entropy.delta_keys_pulled",
+                ):
+                    assert f'mochi_storage{{stat="{stat}"' in prom, stat
+                # the fsync latency histogram rides the registry exposition
+                # ('always' policy: the ack path itself fsync'd above)
+                assert 'name="storage-fsync-ms"' in prom
+                _, _, page = await loop.run_in_executor(None, _get, port, "/")
+                assert "Storage" in page and "wal_entries" in page
+            finally:
+                await admin.close()
+
+    import os
+    import tempfile
+
+    # 'always' so the ack path itself fsyncs: the fsync counter and latency
+    # histogram are then deterministically non-empty (the default 'group'
+    # policy fsyncs on a timer — a race in a test)
+    os.environ["MOCHI_WAL_FSYNC"] = "always"
+    try:
+        with tempfile.TemporaryDirectory() as td:
+            asyncio.run(asyncio.wait_for(body(td), timeout=120))
+    finally:
+        del os.environ["MOCHI_WAL_FSYNC"]
 
 
 def test_fanout_surfaces_and_client_admin_shell():

@@ -28,8 +28,8 @@ def _run(args, timeout):
     )
 
 
-def test_run_all_smoke_covers_all_eleven_configs():
-    proc = _run(["--smoke"], timeout=480)
+def test_run_all_smoke_covers_all_twelve_configs():
+    proc = _run(["--smoke"], timeout=600)
     assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-800:]
     recs = [
         json.loads(line)
@@ -37,9 +37,9 @@ def test_run_all_smoke_covers_all_eleven_configs():
         if line.startswith("{")
     ]
     by_config = {r.get("config"): r for r in recs}
-    # configs 1-11: 11 (byzantine clients) joined in round 13
+    # configs 1-12: 12 (durable storage) joined in round 14
     assert sorted(by_config, key=int) == [
-        str(i) for i in range(1, 12)
+        str(i) for i in range(1, 13)
     ], sorted(by_config)
     for key, rec in sorted(by_config.items()):
         assert not rec.get("error"), (key, rec)
