@@ -128,8 +128,9 @@ async def _wan_run(n_clients: int, keys_per_client: int, sweeps: int) -> Dict:
         totals = sim.totals()
         fanout = _aggregate_fanout(clients)
         breakdown = _commit_breakdown(clients)
+        trace_evidence = _trace_evidence(vc, clients)
 
-    return {
+    rec = {
         "read_ms": _pcts(read_lat),
         "write_ms": _pcts(write_lat),
         "read_samples": len(read_lat),
@@ -141,6 +142,66 @@ async def _wan_run(n_clients: int, keys_per_client: int, sweeps: int) -> Dict:
         # the same shape the admin surfaces export (admin/http._fanout_*).
         "fanout": fanout,
         "commit_breakdown_ms": breakdown,
+    }
+    if trace_evidence is not None:
+        rec["trace"] = trace_evidence
+    return rec
+
+
+def _trace_evidence(vc, clients) -> Optional[Dict]:
+    """Per-transaction causal evidence when the leg ran traced (round 15):
+    the TRACE-derived commit breakdown (p50 of per-card stage durations —
+    cross-checked against the stage TIMERS measured in the same run and
+    against the committed r09 decomposition), plus the cost-card rollup
+    (verifies unique/memoized per txn, wire bytes, RTTs, queue wait)."""
+    from mochi_tpu.obs import trace as obs_trace
+
+    if not any(c.tracer.enabled for c in clients):
+        return None
+    events = []
+    for c in clients:
+        events.extend(c.tracer.events())
+    for r in vc.replicas:
+        events.extend(r.tracer.events())
+    cards = obs_trace.cost_cards(events)
+    # complete write cards only: every client stage present (ring-aged or
+    # partially-sampled traces would skew the stage medians)
+    wanted = ("txn.write", "write1-phase", "write2-fanout-wait", "write2-tally")
+    write_cards = [
+        c for c in cards.values() if all(s in c["stages_us"] for s in wanted)
+    ]
+    if not write_cards:
+        return {"sampled_write_cards": 0}
+
+    def p50(vals: List[float]) -> float:
+        s = sorted(vals)
+        return s[len(s) // 2]
+
+    stage_p50_ms = {
+        stage: round(p50([c["stages_us"][stage] for c in write_cards]) / 1e3, 3)
+        for stage in wanted
+    }
+    return {
+        "sampled_write_cards": len(write_cards),
+        "spans_total": len(events),
+        # the trace-derived commit breakdown (vs the hand stage timers)
+        "commit_breakdown_ms": {
+            k: v for k, v in stage_p50_ms.items() if k != "txn.write"
+        },
+        "txn_write_p50_ms": stage_p50_ms["txn.write"],
+        "cost_card_p50": {
+            "verify_items": p50([c["verify_items"] for c in write_cards]),
+            "verify_unique": round(
+                p50([c["verify_unique"] for c in write_cards]), 2
+            ),
+            "verify_memoized": round(
+                p50([c["verify_memoized"] for c in write_cards]), 2
+            ),
+            "wire_bytes": p50([c["wire_bytes"] for c in write_cards]),
+            "rtt": p50([c["rtt"] for c in write_cards]),
+            "queue_us": round(p50([c["queue_us"] for c in write_cards]), 1),
+            "fsyncs": p50([c["fsyncs"] for c in write_cards]),
+        },
     }
 
 
@@ -380,6 +441,150 @@ def run_passthrough_ab(pairs: int = 15, keys: int = 24) -> Dict:
         rec["overhead_pct_upper_bound_95"] = round((1.0 - ci[0]) * 100.0, 2)
     else:
         rec["ci_note"] = "pairs < 6: no 95% CI is publishable at this n"
+    return rec
+
+
+# ------------------------------------------------------- tracing A/B (r15)
+
+
+def run_trace_ab(pairs: int = 7) -> Dict:
+    """Interleaved paired A/B of the config-7 WAN leg with causal tracing
+    ON at the DEFAULT sample rate vs OFF entirely — the committed bound on
+    what the round-15 tracer costs the write path.  Same discipline as
+    every committed A/B since r06: full-shape legs, order alternating pair
+    to pair, the per-pair write-p50 RATIO as the statistic."""
+    import os as _os
+
+    from mochi_tpu.obs.trace import DEFAULT_SAMPLE_RATE
+
+    def _leg(traced: bool) -> Dict:
+        prev = {
+            k: _os.environ.get(k)
+            for k in ("MOCHI_TRACE", "MOCHI_TRACE_SAMPLE", "MOCHI_TRACE_SEED")
+        }
+        try:
+            if traced:
+                _os.environ["MOCHI_TRACE"] = "1"  # the default sample rate
+                _os.environ["MOCHI_TRACE_SEED"] = str(SEED)
+                _os.environ.pop("MOCHI_TRACE_SAMPLE", None)
+            else:
+                for k in prev:
+                    _os.environ.pop(k, None)
+            return asyncio.run(_wan_run(5, 40, 2))
+        finally:
+            for k, v in prev.items():
+                if v is None:
+                    _os.environ.pop(k, None)
+                else:
+                    _os.environ[k] = v
+
+    rows = []
+    for i in range(pairs):
+        if i % 2 == 0:
+            on = _leg(True)
+            off = _leg(False)
+        else:
+            off = _leg(False)
+            on = _leg(True)
+        rows.append(
+            {
+                "on_write_ms": on["write_ms"],
+                "off_write_ms": off["write_ms"],
+                "p50_ratio": round(
+                    on["write_ms"]["p50"] / off["write_ms"]["p50"], 4
+                ),
+            }
+        )
+    ratios = sorted(r["p50_ratio"] for r in rows)
+    median_ratio = statistics.median(ratios)
+    rec = {
+        "pairs": pairs,
+        "sample_rate": DEFAULT_SAMPLE_RATE,
+        "per_pair": rows,
+        "median_write_p50_ratio": round(median_ratio, 4),
+        "overhead_pct": round((median_ratio - 1.0) * 100.0, 2),
+        "acceptance_le_3pct": median_ratio <= 1.03,
+    }
+    ci = _median_ci95(ratios)
+    if ci is not None:
+        rec["ratio_ci95"] = [round(ci[0], 4), round(ci[1], 4)]
+        rec["overhead_pct_upper_bound_95"] = round((ci[1] - 1.0) * 100.0, 2)
+    return rec
+
+
+# ----------------------------------------------- live verifies/txn meter
+
+
+def run_verify_meter(n: int = 64, writes: int = 4) -> Dict:
+    """The live 43-checks/txn meter at the BASELINE shape: an n=64 rf=n
+    cluster (f=21, quorum=43) with ONE shared caching verifier standing in
+    for the config-6/8 verifier-service posture, writes serialized so the
+    cost cards' unique-vs-memoized split is exact.  BASELINE
+    ``published.6`` derives 43 unique verifies/txn by hand (~2752
+    submitted grant checks collapsing under memoization); this measures
+    the same number from the causal record instead of a constant."""
+    from mochi_tpu.client.txn import TransactionBuilder
+    from mochi_tpu.obs import trace as obs_trace
+    from mochi_tpu.testing.virtual_cluster import VirtualCluster
+    from mochi_tpu.verifier.spi import CachingVerifier, CpuVerifier
+
+    import os as _os
+
+    prev = {
+        k: _os.environ.get(k)
+        for k in ("MOCHI_TRACE", "MOCHI_TRACE_SAMPLE", "MOCHI_TRACE_SEED",
+                  "MOCHI_TRACE_RING")
+    }
+    _os.environ["MOCHI_TRACE_SAMPLE"] = "1.0"
+    _os.environ["MOCHI_TRACE_SEED"] = str(SEED)
+    _os.environ["MOCHI_TRACE_RING"] = "16384"
+    shared = CachingVerifier(CpuVerifier())
+
+    async def body() -> Dict:
+        async with VirtualCluster(n, rf=n, verifier_factory=lambda: shared) as vc:
+            client = vc.client()
+            cards = []
+            for i in range(writes):
+                await client.execute_write_transaction(
+                    TransactionBuilder().write("meter-key", b"v%d" % i).build()
+                )
+            events = list(client.tracer.events())
+            for r in vc.replicas:
+                events.extend(r.tracer.events())
+            for tid, card in obs_trace.cost_cards(events).items():
+                if "txn.write" in card["stages_us"]:
+                    cards.append(card)
+            return {"cards": cards, "quorum": vc.config.quorum}
+
+    try:
+        out = asyncio.run(body())
+    finally:
+        for k, v in prev.items():
+            if v is None:
+                _os.environ.pop(k, None)
+            else:
+                _os.environ[k] = v
+    cards = out["cards"]
+    # steady-state txns only: the FIRST write populates the shared cache
+    # cluster-wide and warms sessions — the meter is the repeat-traffic
+    # figure (BASELINE's 43-unique derivation is steady-state too)
+    steady = cards[1:] if len(cards) > 1 else cards
+    uniq = [c["verify_unique"] for c in steady]
+    memo = [c["verify_memoized"] for c in steady]
+    items = [c["verify_items"] for c in steady]
+    mean = lambda xs: sum(xs) / len(xs) if xs else float("nan")  # noqa: E731
+    rec = {
+        "cluster": {"n": n, "rf": n, "f": (n - 1) // 3, "quorum": out["quorum"]},
+        "writes": writes,
+        "txns_metered": len(steady),
+        "verify_items_per_txn_mean": round(mean(items), 2),
+        "verifies_unique_per_txn_mean": round(mean(uniq), 2),
+        "verifies_memoized_per_txn_mean": round(mean(memo), 2),
+        "baseline_unique_checks": out["quorum"],  # 2f+1 = 43 at n=64
+        "matches_baseline_43": (
+            abs(mean(uniq) - out["quorum"]) <= 0.15 * out["quorum"]
+        ),
+    }
     return rec
 
 

@@ -1,0 +1,165 @@
+"""Round-15 evidence driver: config 7 re-run WITH causal tracing, the
+trace-derived commit breakdown cross-checked against (a) the stage timers
+measured in the SAME run and (b) the committed r09 hand-timer
+decomposition, the live verifies/txn meter at the BASELINE n=64 shape, and
+an interleaved paired A/B bounding tracing overhead at the default sample
+rate.  Writes ``benchmarks/results_r15.json``.
+
+    JAX_PLATFORMS=cpu python -m benchmarks.r15_trace
+
+Acceptance bars (ISSUE 14): trace breakdown vs r09 within 15% per stage
+(stages above a 0.5 ms floor — the sub-0.1 ms tally/encode stages are
+cross-checked against the same-run timers instead, where both sides see
+the same host), live unique verifies/txn within 15% of the BASELINE
+43-unique figure, tracing overhead ≤3% on config-7 write p50.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+
+from benchmarks.config7_wan import (
+    R08_PRIOR,
+    RTT_MS,
+    SEED,
+    _wan_run,
+    run_trace_ab,
+    run_verify_meter,
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(_REPO, "benchmarks", "results_r15.json")
+
+# The committed r09 stage-timer decomposition this round's trace-derived
+# breakdown is cross-checked against (benchmarks/results_r09.json config 7,
+# measured with hand timers around the same client stages).
+R09_BREAKDOWN_MS = {
+    "write1-phase": 22.26,
+    "write2-fanout-wait": 24.83,
+    "write2-tally": 0.03,
+}
+# Stages below this are pure-CPU microseconds-scale: their r09 values are
+# host-speed artifacts, so the 15% cross-check holds them against the
+# SAME-RUN stage timers (identical host, identical run) instead.
+CROSSCHECK_FLOOR_MS = 0.5
+
+
+def _traced_wan_leg() -> dict:
+    prev = {
+        k: os.environ.get(k)
+        for k in ("MOCHI_TRACE", "MOCHI_TRACE_SAMPLE", "MOCHI_TRACE_SEED",
+                  "MOCHI_TRACE_RING")
+    }
+    # sample 1.0 for the EVIDENCE leg: every transaction carries a card, so
+    # the breakdown medians come from the full population (the overhead
+    # bound is the separate A/B below, at the default rate).
+    os.environ["MOCHI_TRACE_SAMPLE"] = "1.0"
+    os.environ["MOCHI_TRACE_SEED"] = str(SEED)
+    os.environ["MOCHI_TRACE_RING"] = "16384"
+    try:
+        return asyncio.run(_wan_run(5, 40, 2))
+    finally:
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _crosscheck(trace_ms: dict, timer_ms: dict) -> dict:
+    """Per-stage agreement: trace-derived vs r09 (stages above the floor)
+    and vs the same-run stage timers (all stages)."""
+    out = {"per_stage": {}, "vs_r09_ok": True, "vs_same_run_ok": True}
+    for stage, r09 in R09_BREAKDOWN_MS.items():
+        tr = trace_ms.get(stage)
+        tm = timer_ms.get(stage)
+        row = {"trace_ms": tr, "same_run_timer_ms": tm, "r09_ms": r09}
+        if tr is None or tm is None:
+            row["error"] = "stage missing"
+            out["vs_r09_ok"] = out["vs_same_run_ok"] = False
+            out["per_stage"][stage] = row
+            continue
+        if r09 >= CROSSCHECK_FLOOR_MS:
+            row["vs_r09_ratio"] = round(tr / r09, 4)
+            row["vs_r09_within_15pct"] = abs(tr / r09 - 1.0) <= 0.15
+            out["vs_r09_ok"] = out["vs_r09_ok"] and row["vs_r09_within_15pct"]
+        else:
+            row["vs_r09_note"] = (
+                "below 0.5 ms floor: r09 value is host-speed-bound; "
+                "cross-checked against the same-run timer instead"
+            )
+        # same-run: both measurements of the same executions — 15% relative
+        # or 0.05 ms absolute (timer vs span clock granularity at the floor)
+        close = abs(tr - tm) <= max(0.15 * max(tr, tm), 0.05)
+        row["vs_same_run_within_15pct"] = close
+        out["vs_same_run_ok"] = out["vs_same_run_ok"] and close
+        out["per_stage"][stage] = row
+    return out
+
+
+def run(ab_pairs: int = 7, meter_writes: int = 4) -> dict:
+    from mochi_tpu.crypto.keys import host_crypto_engine
+    from mochi_tpu.net import transport
+    from mochi_tpu.utils.runtime import tune_gc_for_server
+
+    tune_gc_for_server()
+    prev_floor = transport.RTT_FLOOR_S
+    transport.RTT_FLOOR_S = max(prev_floor, RTT_MS / 1e3)
+    try:
+        wan = _traced_wan_leg()
+        trace_ab = run_trace_ab(pairs=ab_pairs)
+    finally:
+        transport.RTT_FLOOR_S = prev_floor
+    meter = run_verify_meter(writes=meter_writes)
+    crosscheck = _crosscheck(
+        wan.get("trace", {}).get("commit_breakdown_ms", {}),
+        wan.get("commit_breakdown_ms", {}),
+    )
+    rec = {
+        "config": "7",
+        "metric": "wan_traced_causal_accounting",
+        "value": wan["write_ms"]["p50"],
+        "unit": "ms (write p50 at 13 ms RTT, tracing sample=1.0)",
+        "host_crypto_engine": host_crypto_engine(),
+        "round": 15,
+        "write_ms": wan["write_ms"],
+        "read_ms": wan["read_ms"],
+        "write_samples": wan["write_samples"],
+        "netsim_totals": wan["netsim_totals"],
+        "commit_breakdown_timer_ms": wan["commit_breakdown_ms"],
+        "trace": wan.get("trace"),
+        "breakdown_crosscheck": crosscheck,
+        "trace_overhead_ab": trace_ab,
+        "verify_meter": meter,
+        "prior_r09": {
+            "write_p50_ms": 46.07,
+            "breakdown_ms": R09_BREAKDOWN_MS,
+            "source": "benchmarks/results_r09.json config 7",
+        },
+        "prior_r08": R08_PRIOR,
+        "acceptance": {
+            "breakdown_vs_r09_within_15pct": crosscheck["vs_r09_ok"],
+            "breakdown_vs_same_run_within_15pct": crosscheck["vs_same_run_ok"],
+            "verifies_per_txn_matches_baseline_43": meter["matches_baseline_43"],
+            "trace_overhead_le_3pct": trace_ab["acceptance_le_3pct"],
+        },
+    }
+    return rec
+
+
+def main(argv) -> int:
+    ab_pairs = int(argv[0]) if argv else 7
+    rec = run(ab_pairs=ab_pairs)
+    with open(OUT, "w") as fh:
+        json.dump([rec], fh, indent=2)
+        fh.write("\n")
+    print(json.dumps(rec["acceptance"], indent=2))
+    print(f"wrote {OUT}", file=sys.stderr)
+    return 0 if all(rec["acceptance"].values()) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

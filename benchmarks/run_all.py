@@ -118,6 +118,15 @@ def _run_child(key: str) -> None:
     if smoke:
         # tiny-count harness-rot pass: never publish full-evidence legs
         os.environ["MOCHI_BENCH_FULL"] = ""
+        # Tracing rides every smoke leg (round 15) at sample rate 1.0 —
+        # FORCED, not defaulted, and full-rate rather than the 5% default:
+        # at smoke's tiny counts a 5% head sample could legitimately mint
+        # zero traces, and an inherited MOCHI_TRACE_SAMPLE=0 must not
+        # silently hollow out the probe.  The trace_summary stamp below is
+        # the tier-1 check that the tracer plumbing still reaches the
+        # cluster paths each config drives (tests/test_bench_smoke.py
+        # asserts spans were actually recorded on the cluster configs).
+        os.environ["MOCHI_TRACE_SAMPLE"] = "1.0"
     else:
         os.environ.setdefault("MOCHI_BENCH_FULL", "1")  # battery: full evidence
     mod = importlib.import_module(f"benchmarks.{CONFIG_NAMES[key]}")
@@ -162,6 +171,17 @@ def _run_child(key: str) -> None:
         pass
     try:
         rec["platform"] = jax.devices()[0].platform
+    except Exception:
+        pass
+    # Causal-tracing provenance on EVERY record (round 15): the aggregate
+    # over this child's tracers — posture knobs plus span/trace counters.
+    # Always a non-empty dict (the knobs are always known), so a missing
+    # or empty key means the obs plumbing itself rotted — exactly what
+    # tests/test_bench_smoke.py pins.
+    try:
+        from mochi_tpu.obs.trace import global_summary
+
+        rec["trace_summary"] = global_summary()
     except Exception:
         pass
     print("RESULT_JSON " + json.dumps(rec), flush=True)

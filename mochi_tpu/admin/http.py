@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os
 from typing import Optional
 
 from ..verifier.spi import verifier_stats
@@ -93,10 +94,90 @@ def _walk_numeric(prefix: str, obj: dict, out: list) -> None:
 
 def _prom_esc(v) -> str:
     """Prometheus label-value escaping — ONE definition for every
-    hand-rolled exposition block in this module."""
+    hand-rolled exposition block in this module.  Peer/client identity
+    strings are attacker-influenced (a client names itself), so EVERY
+    label value in every family goes through here; the roundtrip contract
+    is pinned by tests/test_metrics_prom.py against a real parser."""
     return (
         str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
     )
+
+
+# ---------------------------------------------------- exposition hygiene
+#
+# Every hand-rolled ``mochi_*`` family carries ``# HELP`` + ``# TYPE``
+# headers (exposition-format parsers and registries key metadata off
+# them), and per-identity label cardinality is BOUNDED: ``mochi_fanout``,
+# ``mochi_client`` and ``mochi_byzantine`` grow one series per peer or
+# client identity, which makes a Sybil flood a memory attack on every
+# scraper downstream of this surface.  Identities past the cap aggregate
+# into a single ``other`` series (top spots go to the highest-activity
+# identities — the rows an operator is hunting — so a flood of one-shot
+# identities lands in ``other`` instead of evicting the evidence).
+
+# Default series cap per identity-labeled family; the env knob is read at
+# CALL time (every other MOCHI_* knob in this round resolves at use, and
+# an operator exporting MOCHI_PROM_MAX_SERIES after import must not be
+# silently ignored).
+PROM_MAX_SERIES = 64
+
+
+def _prom_max_series() -> int:
+    try:
+        return max(2, int(os.environ.get("MOCHI_PROM_MAX_SERIES",
+                                         str(PROM_MAX_SERIES))))
+    except ValueError:
+        return PROM_MAX_SERIES
+
+
+def _family_header(name: str, ftype: str, help_text: str) -> str:
+    return f"# HELP {name} {help_text}\n# TYPE {name} {ftype}\n"
+
+
+def _cap_identities(table: dict, activity) -> dict:
+    """Bound an identity-keyed dict at the series cap: the highest-
+    ``activity`` identities keep their rows (ties broken by name for
+    determinism), the rest fold into ``other`` via ``sum``-merging of
+    numeric leaves.  A literal identity named "other" merges in too —
+    collision-safe by construction, if unattributable."""
+    cap = _prom_max_series()
+    if len(table) <= cap:
+        return table
+    ranked = sorted(table.items(), key=lambda kv: (-activity(kv[1]), kv[0]))
+    kept = dict(ranked[: cap - 1])
+    overflow: dict = {}
+    for _, stats in ranked[cap - 1:]:
+        if isinstance(stats, dict):
+            for k, v in stats.items():
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    overflow[k] = overflow.get(k, 0) + v
+        else:
+            overflow["total"] = overflow.get("total", 0) + stats
+    prev = kept.pop("other", None)
+    if isinstance(prev, dict):
+        for k, v in prev.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                overflow[k] = overflow.get(k, 0) + v
+    elif isinstance(prev, (int, float)):
+        overflow["total"] = overflow.get("total", 0) + prev
+    kept["other"] = overflow
+    return kept
+
+
+def _num_activity(stats) -> float:
+    """Activity rank for the cardinality cap: sum of numeric leaves (a
+    histogram snapshot contributes its count)."""
+    if isinstance(stats, (int, float)):
+        return float(stats)
+    total = 0.0
+    for v in stats.values():
+        if isinstance(v, bool):
+            continue
+        if isinstance(v, (int, float)):
+            total += v
+        elif isinstance(v, dict) and isinstance(v.get("count"), (int, float)):
+            total += v["count"]
+    return total
 
 
 def _live_netsim(replica):
@@ -180,11 +261,16 @@ def _fanout_prom(metrics, label_key: str, label_val: str) -> str:
         return ""
     base = f'{label_key}="{_prom_esc(label_val)}"'
     lines = [
-        "# TYPE mochi_fanout gauge\n",
+        _family_header(
+            "mochi_fanout", "gauge",
+            "Per-peer early-quorum fan-out evidence (stragglers, suspicion); "
+            "identities past the cap aggregate under peer=\"other\"",
+        ),
         f'mochi_fanout{{peer="",stat="early_returns",{base}}} '
         f'{st["early_returns"]}\n',
     ]
-    for peer, stats in sorted(st["peers"].items()):
+    peers = _cap_identities(st["peers"], _num_activity)
+    for peer, stats in sorted(peers.items()):
         pn = _prom_esc(peer)
         for stat, v in sorted(stats.items()):
             if isinstance(v, dict):  # histogram snapshot -> count + mean
@@ -199,7 +285,8 @@ def _fanout_prom(metrics, label_key: str, label_val: str) -> str:
                     )
             else:
                 lines.append(
-                    f'mochi_fanout{{peer="{pn}",stat="{stat}",{base}}} {v}\n'
+                    f'mochi_fanout{{peer="{pn}",stat="{_prom_esc(stat)}",'
+                    f"{base}}} {v}\n"
                 )
     return "".join(lines)
 
@@ -261,7 +348,10 @@ def _byzantine_prom(replica) -> str:
     lines = []
     for stat, per_peer in (("equivocations", bz["equivocations"]),
                            ("bad_grants", bz["bad_grants"])):
-        for peer, n in sorted(per_peer.items()):
+        capped = _cap_identities(dict(per_peer), _num_activity)
+        for peer, n in sorted(capped.items()):
+            if isinstance(n, dict):  # the "other" overflow bucket
+                n = n.get("total", 0)
             lines.append(
                 f'mochi_byzantine{{peer="{_prom_esc(peer)}",stat="{stat}",'
                 f'server="{sid}"}} {n}\n'
@@ -273,7 +363,11 @@ def _byzantine_prom(replica) -> str:
         )
     if not lines:
         return ""
-    return "# TYPE mochi_byzantine gauge\n" + "".join(lines)
+    return _family_header(
+        "mochi_byzantine", "gauge",
+        "Per-peer misbehavior convictions (equivocations, bad grants); "
+        "identities past the cap aggregate under peer=\"other\"",
+    ) + "".join(lines)
 
 
 def _clients_rows(replica) -> str:
@@ -308,18 +402,31 @@ def _clients_prom(replica) -> str:
     see DataStore._client_entry)."""
     st = replica.client_grant_stats()
     sid = _prom_esc(replica.server_id)
-    lines = ["# TYPE mochi_client gauge\n"]
+    lines = [
+        _family_header(
+            "mochi_client", "gauge",
+            "Per-client grant/quota/reclaim accounting (client=\"\" rows "
+            "are aggregates); identities past the cap aggregate under "
+            "client=\"other\"",
+        )
+    ]
     flat: list = []
     _walk_numeric("", {k: v for k, v in st.items() if k != "per_client"}, flat)
     for k, v in flat:
         lines.append(
-            f'mochi_client{{client="",stat="{k}",server="{sid}"}} {v}\n'
+            f'mochi_client{{client="",stat="{_prom_esc(k)}",server="{sid}"}} {v}\n'
         )
-    for cid, cst in sorted(st.get("per_client", {}).items()):
+    per_client = _cap_identities(dict(st.get("per_client", {})), _num_activity)
+    for cid, cst in sorted(per_client.items()):
         cn = _prom_esc(cid)
         for k, v in sorted(cst.items()):
+            if isinstance(v, bool):
+                v = int(v)
+            elif not isinstance(v, (int, float)):
+                continue
             lines.append(
-                f'mochi_client{{client="{cn}",stat="{k}",server="{sid}"}} {v}\n'
+                f'mochi_client{{client="{cn}",stat="{_prom_esc(k)}",'
+                f'server="{sid}"}} {v}\n'
             )
     return "".join(lines)
 
@@ -349,8 +456,11 @@ def _storage_prom(replica) -> str:
     if not samples:
         return ""
     sid = _prom_esc(replica.server_id)
-    return "# TYPE mochi_storage gauge\n" + "".join(
-        f'mochi_storage{{stat="{k}",server="{sid}"}} {v}\n'
+    return _family_header(
+        "mochi_storage", "gauge",
+        "Durable-engine counters (WAL, fsync, snapshots, anti-entropy)",
+    ) + "".join(
+        f'mochi_storage{{stat="{_prom_esc(k)}",server="{sid}"}} {v}\n'
         for k, v in samples
     )
 
@@ -508,6 +618,9 @@ class AdminServer(HttpJsonServer):
                     # outstanding grants, who keeps getting reclaimed
                     # (withholders), who bounces off the quota (hoarders)
                     "clients": r.client_grant_stats(),
+                    # span-ring posture + counters (round 15; the ring
+                    # itself exports at /trace)
+                    "trace": r.tracer.summary(),
                     "config_history_stamps": sorted(r.store.config_history),
                     "member": r.server_id in cfg.servers,
                     "admin_gated": bool(cfg.admin_keys),
@@ -544,8 +657,12 @@ class AdminServer(HttpJsonServer):
             _walk_numeric("", verifier_stats(r.verifier), samples)
             if samples:
                 sid = _prom_esc(r.server_id)
-                body += "# TYPE mochi_verifier gauge\n" + "".join(
-                    f'mochi_verifier{{name="{k}",server="{sid}"}} {v}\n'
+                body += _family_header(
+                    "mochi_verifier", "gauge",
+                    "Verifier-composition counters (batching, caching, comb "
+                    "routing)",
+                ) + "".join(
+                    f'mochi_verifier{{name="{_prom_esc(k)}",server="{sid}"}} {v}\n'
                     for k, v in samples
                 )
             body += _fanout_prom(r.metrics, "server", r.server_id)
@@ -565,16 +682,22 @@ class AdminServer(HttpJsonServer):
             shed_samples: list = []
             _walk_numeric("", r.overload_stats(), shed_samples)
             sid = _prom_esc(r.server_id)
-            body += "# TYPE mochi_shed gauge\n" + "".join(
-                f'mochi_shed{{stat="{k}",server="{sid}"}} {v}\n'
+            body += _family_header(
+                "mochi_shed", "gauge",
+                "Admission-control state and deterministic load signal",
+            ) + "".join(
+                f'mochi_shed{{stat="{_prom_esc(k)}",server="{sid}"}} {v}\n'
                 for k, v in shed_samples
             )
             # Per-shard ownership/traffic gauges: one family, stat-labeled,
             # so "is any replica serving foreign-shard traffic?" is a single
             # PromQL query across the fleet.
             sid = _prom_esc(r.server_id)
-            body += "# TYPE mochi_shard gauge\n" + "".join(
-                f'mochi_shard{{stat="{k}",server="{sid}"}} {v}\n'
+            body += _family_header(
+                "mochi_shard", "gauge",
+                "Token-ring ownership and owned/foreign traffic counters",
+            ) + "".join(
+                f'mochi_shard{{stat="{_prom_esc(k)}",server="{sid}"}} {v}\n'
                 for k, v in sorted(r.store.shard_stats().items())
             )
             netsim = _live_netsim(r)
@@ -587,17 +710,30 @@ class AdminServer(HttpJsonServer):
                 # and exporting the full table from each would make a
                 # multi-replica scrape over-count every link.
                 sid = _prom_esc(r.server_id)
-                lines = ["# TYPE mochi_netsim gauge\n"]
+                lines = [
+                    _family_header(
+                        "mochi_netsim", "gauge",
+                        "Per-directed-link network-conditioning counters",
+                    )
+                ]
                 link_stats = netsim.stats(endpoint=r.server_id)["links"]
                 for link, stats in sorted(link_stats.items()):
                     ln = _prom_esc(link)
                     for stat, v in stats.items():
                         lines.append(
-                            f'mochi_netsim{{link="{ln}",stat="{stat}",'
+                            f'mochi_netsim{{link="{ln}",stat="{_prom_esc(stat)}",'
                             f'server="{sid}"}} {int(v)}\n'
                         )
                 body += "".join(lines)
             return (200, "text/plain; version=0.0.4", body)
+        if path == "/trace":
+            # Chrome trace-event export of the replica's span ring (round
+            # 15, obs/trace.py): load directly in chrome://tracing or
+            # Perfetto, or merge multi-process dumps with
+            # ``python -m mochi_tpu.tools.trace``.
+            return 200, "application/json", json.dumps(
+                r.tracer.export_chrome()
+            )
         if path == "/" or path == "/index.html":
             cfg = r.config
             member_rows = "".join(
@@ -715,6 +851,8 @@ class ClientAdminServer(HttpJsonServer):
                     "suspicion": c.suspicion_stats(),
                     # this identity's own grant-quota view (round 13)
                     "clients": _client_grant_view(c),
+                    # span-ring posture (round 15; ring exports at /trace)
+                    "trace": c.tracer.summary(),
                     "timers": {
                         name: t.snapshot() for name, t in sorted(m.timers.items())
                     },
@@ -726,6 +864,13 @@ class ClientAdminServer(HttpJsonServer):
             body = m.to_prometheus({"client": c.client_id})
             body += _fanout_prom(m, "client", c.client_id)
             return 200, "text/plain; version=0.0.4", body
+        if path == "/trace":
+            # The initiator-side half of a transaction's causal record:
+            # merge with the replicas' /trace dumps by trace_id
+            # (tools/trace.py) for the end-to-end span tree.
+            return 200, "application/json", json.dumps(
+                c.tracer.export_chrome()
+            )
         if path == "/" or path == "/index.html":
             timer_rows = "".join(
                 f"<tr><td>{_esc(name)}</td><td>n={t.count} "

@@ -320,6 +320,7 @@ def _checkers():
         const_time,
         invariants,
         native_ct,
+        span_lazy,
         trace_safety,
     )
 
@@ -331,6 +332,7 @@ def _checkers():
         invariants,
         await_races,
         native_ct,
+        span_lazy,
     ]
 
 

@@ -61,9 +61,10 @@ from ..protocol import (
     WriteCertificate,
     transaction_hash,
 )
+from ..obs import trace as obs_trace
 from ..utils.metrics import Metrics
 from .errors import InconsistentRead, InconsistentWrite, RequestRefused
-from .txn import GrantAssembler, QuorumTally
+from .txn import GrantAssembler, QuorumTally, TxnTrace
 import time
 
 LOG = logging.getLogger(__name__)
@@ -178,6 +179,13 @@ class MochiDBClient:
             local_label=self.netsim_label or self.client_id,
         )
         self.metrics = Metrics()
+        # Causal tracing (round 15, obs/trace.py): contexts mint per
+        # transaction via client/txn.TxnTrace; sampled contexts ride every
+        # envelope this client sends.  Off (MOCHI_TRACE* unset) the tracer
+        # never mints and every trace site is one None test.
+        self.tracer = obs_trace.Tracer(
+            f"client:{self.netsim_label or self.client_id[:20]}"
+        )
         self._rand = random.Random()
         # server_id -> session MAC key; Ed25519 envelope signing is the
         # fallback (and the handshake carrier) — crypto/session.py.
@@ -216,6 +224,13 @@ class MochiDBClient:
         self._suspicion_events.setdefault(sid, deque(maxlen=4096)).append(
             time.monotonic()
         )
+        # Always-sample upgrade: a suspicion mark is exactly the evidence a
+        # trace exists for — record it even when the head verdict was skip.
+        ctx = obs_trace.current_ctx()
+        if ctx is not None:
+            self.tracer.force_mark(
+                "client.suspect", ctx, args={"kind": kind, "peer": sid}
+            )
 
     def _suspicion_score(self, sid: str) -> int:
         """Misbehavior evidence against ``sid`` within the last
@@ -328,11 +343,20 @@ class MochiDBClient:
         # MAC/sign), the "fan-out serialization" slice of the commit
         # breakdown (benchmarks/config6_bigcluster.py).
         with self.metrics.timer("envelope-encode-sign"):
+            # Propagate the txn's trace context (round 15) — SAMPLED traces
+            # only, so unsampled traffic keeps the exact pre-trace wire
+            # bytes and the native envelope-decode fast path on every hop.
+            trace_field = None
+            if self.tracer.enabled:
+                ctx = obs_trace.current_ctx()
+                if ctx is not None and ctx.sampled:
+                    trace_field = ctx.to_wire()
             env = Envelope(
                 payload=payload,
                 msg_id=msg_id,
                 sender_id=self.client_id,
                 timestamp_ms=int(time.time() * 1000),
+                trace=trace_field,
             )
             session_key = self._sessions.get(sid) if sid is not None else None
             if session_key is not None and not self._needs_signature(payload):
@@ -539,6 +563,7 @@ class MochiDBClient:
             self.timeout_s,
             metrics=self.metrics,
             quorum_done=quorum_done,
+            tracer=self.tracer,
         )
         out: Dict[str, object] = {}
         stale_sessions = []
@@ -609,14 +634,22 @@ class MochiDBClient:
         replica set this client still targets — adopt the newer committed
         config if there is one and retry once.
         """
+        # One trace context per TRANSACTION (not per attempt): retries and
+        # recovery reads stay inside the same causal record (obs/trace.py).
+        with TxnTrace(self.tracer, "txn.read") as tt:
+            return await self._read_with_recovery(transaction, tt)
+
+    async def _read_with_recovery(
+        self, transaction: Transaction, tt: TxnTrace
+    ) -> TransactionResult:
         try:
             try:
-                return await self._read_once(transaction, trim=True)
+                return await self._read_once(transaction, trim=True, tt=tt)
             except InconsistentRead:
                 # The quorum-sized fan-out can miss when a chosen replica
                 # lags a fresh commit or times out — the full union is the
                 # authoritative attempt.
-                return await self._read_once(transaction, trim=False)
+                return await self._read_once(transaction, trim=False, tt=tt)
         except InconsistentRead as failure:
             if transaction.keys == (CONFIG_CLUSTER_KEY,):
                 raise
@@ -625,7 +658,7 @@ class MochiDBClient:
                 # WRONG_SHARD, so responders can even be 0): retry against
                 # the NEW replica set first — usually it answers outright.
                 try:
-                    return await self._read_once(transaction, trim=False)
+                    return await self._read_once(transaction, trim=False, tt=tt)
                 except InconsistentRead as exc:
                     # New members may still be syncing; fall through to the
                     # nudge+poll recovery with the post-refresh evidence.
@@ -648,7 +681,7 @@ class MochiDBClient:
             for delay in (0.15, 0.35, 0.8):
                 await asyncio.sleep(delay)
                 try:
-                    return await self._read_once(transaction, trim=False)
+                    return await self._read_once(transaction, trim=False, tt=tt)
                 except InconsistentRead as exc:
                     last = exc
             raise last
@@ -665,11 +698,15 @@ class MochiDBClient:
         )
 
     async def _read_once(
-        self, transaction: Transaction, trim: bool = False
+        self, transaction: Transaction, trim: bool = False,
+        tt: Optional[TxnTrace] = None,
     ) -> TransactionResult:
+        if tt is None:
+            tt = TxnTrace(None, "txn.read")  # span-less (internal callers)
         with self.metrics.timer("read-transactions"):
             nonce = new_msg_id()
-            with self.metrics.timer("read-transactions-step1-future-wait"):
+            with self.metrics.timer("read-transactions-step1-future-wait"), \
+                    tt.stage("read-step1-wait"):
                 # One shared payload for every target: the envelope layer
                 # caches the payload's mcode bytes on the object, so the
                 # n-way fan-out pays one payload-tree encode, not n
@@ -975,7 +1012,8 @@ class MochiDBClient:
     async def execute_write_transaction(self, transaction: Transaction) -> TransactionResult:
         """2-phase write: Write1 grant acquisition → Write2 certificate commit
         (ref: ``executeWriteTransactionBL``, ``MochiDBClient.java:237-387``)."""
-        with self.metrics.timer("write-transactions"):
+        with self.metrics.timer("write-transactions"), \
+                TxnTrace(self.tracer, "txn.write") as tt:
             txn_hash = transaction_hash(transaction)
             write1_txn = self._write1_transaction(transaction)
             refusals = 0
@@ -1012,7 +1050,8 @@ class MochiDBClient:
                         and assembler.add(payload.multi_grant)
                     )
 
-                with self.metrics.timer("write1-phase"):
+                with self.metrics.timer("write1-phase"), \
+                        tt.stage("write1-phase"):
                     responses = await self._fan_out(
                         write1_txn,
                         lambda: w1_payload,
@@ -1159,7 +1198,7 @@ class MochiDBClient:
                     continue
                 certificate = WriteCertificate({mg.server_id: mg for mg in chosen})
                 try:
-                    return await self._write2(transaction, certificate)
+                    return await self._write2(transaction, certificate, tt)
                 except InconsistentWrite as exc:
                     # A reconfiguration may have landed between our phases
                     # (replicas reject cross-config certificates).  Adopt
@@ -1219,8 +1258,11 @@ class MochiDBClient:
             pass
 
     async def _write2(
-        self, transaction: Transaction, certificate: WriteCertificate
+        self, transaction: Transaction, certificate: WriteCertificate,
+        tt: Optional[TxnTrace] = None,
     ) -> TransactionResult:
+        if tt is None:
+            tt = TxnTrace(None, "txn.write")  # span-less (internal callers)
         # Shared payload: at n=64 the 43-grant certificate is ~9.8 KB and
         # was re-encoded per target (96% of envelope encode cost, round-5
         # profile); the payload-level mcode cache makes this one encode.
@@ -1252,11 +1294,12 @@ class MochiDBClient:
         # now spans send-to-all through the QUORUM point (stragglers drain
         # off the clock) — it CONTAINS each replica's verify wait + store
         # apply plus the wire/loop time; the tally is pure client CPU.
-        with self.metrics.timer("write2-fanout-wait"):
+        with self.metrics.timer("write2-fanout-wait"), \
+                tt.stage("write2-fanout-wait"):
             responses = await self._fan_out(
                 transaction, lambda: w2_payload, arrived=w2_arrived
             )
-        with self.metrics.timer("write2-tally"):
+        with self.metrics.timer("write2-tally"), tt.stage("write2-tally"):
             return self._tally_write2(transaction, responses)
 
     def _tally_write2(

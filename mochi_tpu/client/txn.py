@@ -13,10 +13,115 @@ delay a transaction but can never commit one on thin evidence.
 
 from __future__ import annotations
 
+import time
 from collections import defaultdict
 from typing import Callable, Dict, List, Optional, Sequence, Set
 
+from ..obs import trace as obs_trace
 from ..protocol import Action, MultiGrant, Operation, Transaction
+
+
+class _NoopStage:
+    """Shared do-nothing stage span (tracing off / head-unsampled): the
+    hot path pays one attribute test and zero allocations."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NoopStage":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        pass
+
+
+_NOOP_STAGE = _NoopStage()
+
+
+class _StageSpan:
+    """One client txn stage as a span: picks its span id up front and
+    points ``obs.trace.CURRENT`` at a child context for its duration, so
+    every envelope the stage fans out parents the remote side's spans
+    under THIS stage (write1-phase / write2-fanout-wait / ...)."""
+
+    __slots__ = ("tracer", "ctx", "name", "sid", "_t0", "_wall0", "_tok")
+
+    def __init__(self, tracer: "obs_trace.Tracer", ctx, name: str):
+        self.tracer = tracer
+        self.ctx = ctx
+        self.name = name
+        self.sid = tracer.new_span_id()
+        self._tok = None
+
+    def __enter__(self) -> "_StageSpan":
+        self._wall0 = time.time()
+        self._t0 = time.perf_counter()
+        self._tok = obs_trace.CURRENT.set(self.ctx.child(self.sid))
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if self._tok is not None:
+            obs_trace.CURRENT.reset(self._tok)
+        self.tracer.record(
+            self.name,
+            self.ctx,
+            self._wall0,
+            time.perf_counter() - self._t0,
+            span_id=self.sid,
+            args={"error": exc_type.__name__} if exc_type is not None else None,
+            force=exc_type is not None,  # always-sample upgrade on error
+        )
+
+
+class TxnTrace:
+    """Per-transaction causal-trace handle — the MINT POINT of the round-15
+    tracing tentpole: one :class:`~mochi_tpu.obs.trace.TraceContext`
+    (trace_id, span_id, parent_id, sampled) per client transaction, with
+    head-based seeded sampling decided here and nowhere else.
+
+    Used as a context manager around the whole transaction: ``CURRENT``
+    carries the context across every await of the txn's task (so error
+    paths can force-sample even when the head verdict was "skip"), stages
+    open child spans via :meth:`stage`, and the root span records at exit
+    (name ``txn.write`` / ``txn.read``, error-forced when the transaction
+    raised).  With tracing disabled the whole object costs one ``None``
+    check per call site.
+    """
+
+    __slots__ = ("tracer", "ctx", "kind", "_t0", "_wall0", "_tok")
+
+    def __init__(self, tracer: "Optional[obs_trace.Tracer]", kind: str):
+        self.tracer = tracer
+        self.kind = kind
+        self.ctx = tracer.mint() if tracer is not None else None
+        self._tok = None
+
+    def __enter__(self) -> "TxnTrace":
+        if self.ctx is not None:
+            self._wall0 = time.time()
+            self._t0 = time.perf_counter()
+            self._tok = obs_trace.CURRENT.set(self.ctx)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if self.ctx is None:
+            return
+        if self._tok is not None:
+            obs_trace.CURRENT.reset(self._tok)
+        self.tracer.record(
+            self.kind,
+            self.ctx,
+            self._wall0,
+            time.perf_counter() - self._t0,
+            span_id=self.ctx.span_id,  # the root span records itself
+            args={"error": exc_type.__name__} if exc_type is not None else None,
+            force=exc_type is not None,
+        )
+
+    def stage(self, name: str):
+        """Child span for one protocol stage; no-op unless head-sampled."""
+        if self.ctx is None or not self.ctx.sampled:
+            return _NOOP_STAGE
+        return _StageSpan(self.tracer, self.ctx, name)
 
 
 class GrantAssembler:

@@ -44,6 +44,7 @@ import time
 from typing import Awaitable, Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
 from ..cluster.config import ServerInfo
+from ..obs import trace as obs_trace
 from ..utils.metrics import LATENCY_BOUNDS_S, STRAGGLER_BOUNDS_MS
 from ..protocol import (
     Envelope,
@@ -507,6 +508,12 @@ class RpcServer:
         return total
 
     def _enqueue(self, proto: _RpcServerProtocol, env: Envelope) -> None:
+        if env.trace is not None:
+            # Traced (head-sampled) envelope: stamp ingress so the handler
+            # can attribute queue/drain wait to the transaction.  Stashed in
+            # __dict__ exactly like the payload's _mcode cache; untraced
+            # traffic pays one attribute test.
+            env.__dict__["_rx_perf"] = time.perf_counter()
         self._ingress.append((proto, env))
         if not self._drain_scheduled:
             self._drain_scheduled = True
@@ -1179,6 +1186,7 @@ async def fan_out(
     timeout_s: Optional[float] = None,
     metrics=None,
     quorum_done: Optional[Callable[[str, object], bool]] = None,
+    tracer=None,
 ) -> Dict[str, "Envelope | Exception"]:
     """Send one envelope per target concurrently; gather results or exceptions
     per server id (ref: ``Utils.sendMessageToServers`` + ``busyWaitForFutures``,
@@ -1222,6 +1230,17 @@ async def fan_out(
     # only for fast-path bare futures, whose pending-map entry we own.
     fut_info: Dict[asyncio.Future, Tuple[str, Optional[str], Optional[_Connection]]] = {}
     slow: List[Tuple[str, ServerInfo]] = []
+    # Per-txn wire accounting (round 15): only for a head-SAMPLED trace
+    # context — the lazy-label discipline: no span bookkeeping, no arg
+    # building, for the ~95% of traffic the sampler skips.
+    ctx = None
+    if tracer is not None and tracer.enabled:
+        c = obs_trace.current_ctx()
+        if c is not None and c.sampled:
+            ctx = c
+    wire_bytes = 0
+    fan_wall0 = time.time() if ctx is not None else 0.0
+    fan_t0 = time.perf_counter() if ctx is not None else 0.0
     send_t0 = time.perf_counter() if metrics is not None else 0.0
     for sid, info in targets:
         conn = pool._conn(info)
@@ -1236,7 +1255,10 @@ async def fan_out(
             # error) instead of growing without bound
             conn.register_pending(env.msg_id, fut)
             assert conn._proto is not None
-            conn._proto.send_frame(encode_envelope(env))
+            frame = encode_envelope(env)
+            if ctx is not None:
+                wire_bytes += len(frame) + 4  # + length prefix
+            conn._proto.send_frame(frame)
         except Exception as exc:
             conn.pending.pop(env.msg_id, None)
             out[sid] = exc
@@ -1252,8 +1274,15 @@ async def fan_out(
         # ensure_connected — a black-holed host (dropped SYNs) otherwise
         # holds create_connection for the kernel's ~2 min connect timeout,
         # far past this fan-out's budget.
+        env = make_envelope(new_msg_id(), sid)
+        if ctx is not None:
+            # charge the slow-path leg's frame too (encode here is cheap:
+            # the envelope's _six_bytes/payload caches make it pure
+            # concatenation, and send_and_receive reuses the same caches)
+            nonlocal wire_bytes
+            wire_bytes += len(encode_envelope(env)) + 4
         return await asyncio.wait_for(
-            pool.send_and_receive(info, make_envelope(new_msg_id(), sid), timeout),
+            pool.send_and_receive(info, env, timeout),
             timeout=timeout,
         )
 
@@ -1332,6 +1361,21 @@ async def fan_out(
                 fut.cancel()
                 out[sid] = TimeoutError(f"no response from {sid} in {timeout}s")
         pending = set()
+        if ctx is not None:
+            # One span per fan-out = one protocol round trip: the cost
+            # card's RTT counter and wire-byte ledger (obs/trace.py).
+            tracer.record(
+                "client.fanout",
+                ctx,
+                fan_wall0,
+                time.perf_counter() - fan_t0,
+                args={
+                    "targets": len(targets),
+                    "wire_bytes": wire_bytes,
+                    "rtt": 1,
+                    "early": early,
+                },
+            )
         return out
     finally:
         # Structured concurrency: if the fan-out itself is cancelled

@@ -188,20 +188,26 @@ def _decode_py(data: bytes) -> Any:
 
 
 def _decode_env_py(data: bytes) -> "tuple[list, int]":
-    """Decode a wire envelope (top-level 8-element list) and report the
-    stream offset just past element 6.  The signed prefix of an envelope is
-    a contiguous slice of its wire encoding (see ``messages.Envelope``), so
-    receivers authenticate by slicing instead of re-encoding the payload."""
+    """Decode a wire envelope (top-level 8- or 9-element list) and report
+    the stream offset just past element 6.  The signed prefix of an
+    envelope is a contiguous slice of its wire encoding (see
+    ``messages.Envelope``), so receivers authenticate by slicing instead of
+    re-encoding the payload.  The optional 9th element is the round-15
+    trace-context field (UNauthenticated, advisory — see
+    ``messages.decode_envelope``); 8-element frames stay byte-identical to
+    every prior round.  Tolerance is one-directional: pre-round-15 readers
+    reject the 9-element form, so traced envelopes require an upgraded
+    fleet (docs/OPERATIONS.md §4j)."""
     reader = _Reader(bytes(data))
     if not reader.data or reader.data[0] != T_LIST:
         raise ValueError("mcode: envelope must be a list")
     reader.pos = 1
     n = reader.read_varint()
-    if n != 8:
-        raise ValueError(f"mcode: envelope needs 8 elements, got {n}")
+    if n not in (8, 9):
+        raise ValueError(f"mcode: envelope needs 8 or 9 elements, got {n}")
     values = []
     off6 = 0
-    for i in range(8):
+    for i in range(n):
         values.append(reader.read_value(1))
         if i == 5:
             off6 = reader.pos
@@ -221,7 +227,22 @@ def _bind():
         if mod is not None:
             # decode_env: getattr-guard so a stale prebuilt .so (older than
             # this source) still binds its encode/decode.
-            return mod.encode, mod.decode, getattr(mod, "decode_env", _decode_env_py)
+            native_env = getattr(mod, "decode_env", None)
+            if native_env is not None:
+                # The prebuilt native decode_env predates the round-15
+                # 9-element (traced) envelope and rejects it; dispatch on
+                # the outer count byte — 8-element frames (ALL untraced
+                # traffic, i.e. everything unless a trace context was
+                # head-sampled onto this envelope) keep the native fast
+                # path, traced ones take the pure-Python decoder.  The
+                # count byte is a single-byte varint for both (8, 9).
+                def decode_env_dispatch(data):
+                    if len(data) >= 2 and data[1] == 0x09:
+                        return _decode_env_py(data)
+                    return native_env(data)
+
+                return mod.encode, mod.decode, decode_env_dispatch
+            return mod.encode, mod.decode, _decode_env_py
     except Exception:  # pragma: no cover - import-time safety net
         pass
     return _encode_py, _decode_py, _decode_env_py

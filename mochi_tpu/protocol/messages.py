@@ -768,6 +768,20 @@ class Envelope:
     timestamp_ms: int = 0
     signature: Optional[bytes] = None
     mac: Optional[bytes] = None  # session MAC (``crypto/session.py``)
+    # Round-15 causal-trace context (obs/trace.py), a TOLERATED new wire
+    # field: ``(trace_id_bytes, span_id_bytes, flags)`` rides as an
+    # OPTIONAL 9th envelope element — absent (None, the default), the wire
+    # form is byte-identical to every prior round, and round-15 readers
+    # accept both arities.  Tolerance is one-directional: a PRE-round-15
+    # reader rejects the 9-element form at decode, so mixed-version
+    # clusters must keep tracing off until the fleet is upgraded
+    # (docs/OPERATIONS.md §4j "Upgrade posture").  Deliberately OUTSIDE
+    # the signed prefix: the context is advisory observability, so a
+    # tamperer can at worst mis-attribute spans, never influence a
+    # protocol decision — and keeping it out of ``signing_bytes`` means
+    # attaching/stripping it can never invalidate a signature or MAC
+    # computed by an older peer.
+    trace: Optional[tuple] = None
 
     @cached_property
     def _payload_obj(self) -> Any:
@@ -846,19 +860,33 @@ def encode_envelope(env: Envelope) -> bytes:
     # Wire = T_LIST(8) + the cached 6 authenticated elements + sig + mac.
     # The seal/sign step already computed _six_bytes (signing_bytes), and
     # with_mac/with_signature carry the cache, so this is pure concatenation.
-    return b"\x07\x08" + env._six_bytes[2:] + _enc_auth(env.signature) + _enc_auth(env.mac)
+    # A trace context (round 15) appends as a 9th, UNauthenticated element
+    # — emitted only when present, so untraced traffic stays byte-identical
+    # to the pre-trace wire form (and on the native decode fast path).
+    base = env._six_bytes[2:] + _enc_auth(env.signature) + _enc_auth(env.mac)
+    if env.trace is None:
+        return b"\x07\x08" + base
+    return b"\x07\x09" + base + encode(list(env.trace))
 
 
 def decode_envelope(data: bytes) -> Envelope:
     # Canonical-header check (ADVICE r3): the signed-prefix reconstruction
-    # below assumes the outer varint(8) is the single byte 0x08.  The codec
-    # readers now reject non-minimal varints everywhere, but a STALE
-    # prebuilt native .so (bound via the getattr guard in codec._bind)
-    # could predate that check — this belt-and-braces guard keeps the
-    # _six_bytes slice sound regardless of which codec decoded the frame.
-    if len(data) < 2 or data[1] != 0x08:
-        raise ValueError("mcode: envelope header must be canonical T_LIST(8)")
-    (tag, payload_obj, msg_id, sender_id, reply_to, ts, sig, mac), off6 = decode_env(data)
+    # below assumes the outer varint is the single byte 0x08 — or 0x09 for
+    # the round-15 traced form.  The codec readers now reject non-minimal
+    # varints everywhere, but a STALE prebuilt native .so (bound via the
+    # getattr guard in codec._bind) could predate that check — this
+    # belt-and-braces guard keeps the _six_bytes slice sound regardless of
+    # which codec decoded the frame.
+    if len(data) < 2 or data[1] not in (0x08, 0x09):
+        raise ValueError("mcode: envelope header must be canonical T_LIST(8|9)")
+    vals, off6 = decode_env(data)
+    tag, payload_obj, msg_id, sender_id, reply_to, ts, sig, mac = vals[:8]
+    trace = None
+    if len(vals) > 8 and isinstance(vals[8], list) and len(vals[8]) == 3:
+        # Advisory field: anything malformed decodes as "no trace" rather
+        # than costing the (validly authenticated) envelope that carried it
+        # — obs.trace.TraceContext.from_wire re-validates the element types.
+        trace = tuple(vals[8])
     if not 0 <= tag < len(_PAYLOAD_TYPES):
         raise ValueError(f"unknown payload tag {tag}")
     payload = _PAYLOAD_TYPES[tag].from_obj(payload_obj)
@@ -871,6 +899,7 @@ def decode_envelope(data: bytes) -> Envelope:
         timestamp_ms=ts,
         signature=sig,
         mac=mac,
+        trace=trace,
         # The signed prefix is a contiguous slice of the frame: recovering
         # it here means authenticating this envelope (signing_bytes) never
         # re-encodes the payload tree it just decoded.

@@ -95,6 +95,7 @@ class InvariantChecker:
         # key -> latest acked value (None = acked delete): invariant 3.
         self.acked: Dict[str, Optional[bytes]] = {}
         self.acked_writes = 0
+        self._flight_dumps = 0
         # key -> values of writes whose client call failed AFTER dispatch
         # (timeout, tally shortfall on a lossy link): outcome indeterminate
         # — the write may have committed even though the workload saw an
@@ -124,10 +125,29 @@ class InvariantChecker:
 
     # ------------------------------------------------------------- sampling
 
+    # Flight-recorder dumps per run: a conviction storm must write bounded
+    # evidence, not a disk flood — the first few violations carry the
+    # causal record; the rest are counted in ``violations``.
+    _MAX_FLIGHT_DUMPS = 8
+
     def _violate(self, msg: str) -> None:
         if len(self.violations) < 256:  # bounded evidence, not a log flood
             self.violations.append(msg)
         LOG.error("SAFETY INVARIANT VIOLATED: %s", msg)
+        # Conviction flight recorder (round 15): drive every honest
+        # replica's span ring to disk with the violation attached, so the
+        # verdict ships with the causal record of the traffic around it.
+        # No-op unless a flight dir is configured (MOCHI_TRACE_DIR).
+        if self._flight_dumps < self._MAX_FLIGHT_DUMPS:
+            self._flight_dumps += 1
+            for replica in self.replicas:
+                tracer = getattr(replica, "tracer", None)
+                if tracer is None or not tracer.flight_dir:
+                    continue
+                try:
+                    tracer.dump_flight("invariant-violation", {"violation": msg})
+                except OSError:
+                    LOG.exception("invariant flight dump failed")
 
     def check_now(self) -> None:
         """One pass of invariants 1 + 2 over the honest replicas' stores.

@@ -189,17 +189,29 @@ class Metrics:
     def to_prometheus(self, labels: Dict[str, str]) -> str:
         """Prometheus text exposition (the Dropwizard/JMX-reporter analog
         for a modern scrape stack).  Metric identity goes into the ``name``
-        label so arbitrary dotted timer names stay valid."""
+        label so arbitrary dotted timer names stay valid.
+
+        Exposition hygiene (round-15 satellite): every family carries
+        ``# HELP`` + ``# TYPE`` headers, and EVERY label value — including
+        the ``name`` label, whose dotted metric names embed peer/client
+        ids on the suspicion/fan-out counters, i.e. attacker-influenced
+        strings — is escaped.  tests/test_metrics_prom.py parser-roundtrips
+        the whole body."""
 
         def esc(v: str) -> str:
             return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
 
         base = ",".join(f'{k}="{esc(v)}"' for k, v in sorted(labels.items()))
         lines = [
+            "# HELP mochi_timer_count Lifetime sample count per named timer",
             "# TYPE mochi_timer_count counter",
+            "# HELP mochi_timer_seconds_mean Lifetime mean duration per named timer",
             "# TYPE mochi_timer_seconds_mean gauge",
+            "# HELP mochi_timer_seconds_p50 Sliding-window median duration per named timer",
             "# TYPE mochi_timer_seconds_p50 gauge",
+            "# HELP mochi_timer_seconds_p99 Sliding-window p99 duration per named timer",
             "# TYPE mochi_timer_seconds_p99 gauge",
+            "# HELP mochi_counter_total Monotonic event counters by name",
             "# TYPE mochi_counter_total counter",
         ]
         for name, t in sorted(self.timers.items()):
@@ -217,11 +229,13 @@ class Metrics:
             lab = f'name="{esc(name)}"' + (f",{base}" if base else "")
             lines.append(f"mochi_counter_total{{{lab}}} {n}")
         if self.gauges:
+            lines.append("# HELP mochi_gauge Last-write-wins instantaneous values by name")
             lines.append("# TYPE mochi_gauge gauge")
             for name, v in sorted(self.gauges.items()):
                 lab = f'name="{esc(name)}"' + (f",{base}" if base else "")
                 lines.append(f"mochi_gauge{{{lab}}} {v:g}")
         if self.histograms:
+            lines.append("# HELP mochi_histogram Fixed-bucket occupancy/latency histograms by name")
             lines.append("# TYPE mochi_histogram histogram")
             for name, h in sorted(self.histograms.items()):
                 lab = f'name="{esc(name)}"' + (f",{base}" if base else "")

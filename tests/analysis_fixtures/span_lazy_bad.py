@@ -1,0 +1,27 @@
+"""Seeded violations for the span-lazy-label rule: eager string formatting
+in span-record arguments on the (simulated) drain hot loop."""
+import time
+
+
+class Tracer:
+    def record(self, name, ctx, t0, dur, args=None):
+        pass
+
+    def span(self, name, ctx):
+        pass
+
+
+tracer = Tracer()
+
+
+def drain(envs, ctx):
+    t0 = time.time()
+    for i, env in enumerate(envs):
+        # BAD: f-string label evaluated per envelope, sampled or not
+        tracer.record(f"drain.env-{i}", ctx, t0, 0.0)
+        # BAD: %-format in an args value
+        tracer.record("drain", ctx, t0, 0.0, args={"peer": "peer-%s" % env})
+        # BAD: .format() label
+        tracer.record("drain.{}".format(env), ctx, t0, 0.0)
+        # BAD: string concatenation label
+        tracer.span("drain." + str(i), ctx)

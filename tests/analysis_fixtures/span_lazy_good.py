@@ -1,0 +1,39 @@
+"""Clean counterparts for the span-lazy-label rule: constant names, plain
+values, sampling-gated formatting, and the force_mark exemption."""
+import time
+
+
+class Tracer:
+    def record(self, name, ctx, t0, dur, args=None):
+        pass
+
+    def force_mark(self, name, ctx, args=None):
+        pass
+
+    def wants(self, ctx):
+        return ctx is not None and ctx.sampled
+
+
+tracer = Tracer()
+
+
+def drain(envs, ctx):
+    t0 = time.time()
+    for i, env in enumerate(envs):
+        # GOOD: constant name, plain-value args — nothing formats eagerly
+        tracer.record("drain.env", ctx, t0, 0.0, args={"index": i, "peer": env})
+        # GOOD: formatting behind the sampling gate (only paid when the
+        # span actually records)
+        if tracer.wants(ctx):
+            tracer.record(f"drain.env-{i}", ctx, t0, 0.0)
+        if ctx is not None and ctx.sampled:
+            tracer.record("drain", ctx, t0, 0.0, args={"peer": "p-%s" % env})
+        # GOOD: force_mark is the always-sampled upgrade path — it records
+        # unconditionally, so eager formatting is paid only on real events
+        tracer.force_mark(f"drain.error-{i}", ctx)
+        # GOOD: metrics timers are not span records (rule must not trip)
+        metrics_record(f"timer-{i}")
+
+
+def metrics_record(name):
+    pass

@@ -108,6 +108,20 @@ async def _main():
                 if line and not line.startswith("#"):
                     assert "} " in line and line.startswith("mochi_"), line
 
+            # round-15 causal tracing: the span-ring posture on /status and
+            # the Chrome trace-event export at /trace (empty ring without
+            # MOCHI_TRACE*, but the surface must exist and parse)
+            tr = doc["trace"]
+            for k in ("enabled", "sample_rate", "ring", "spans_recorded"):
+                assert k in tr, tr
+            status, ctype, body = await loop.run_in_executor(
+                None, _get, port, "/trace"
+            )
+            assert status == 200 and ctype == "application/json"
+            trace_doc = json.loads(body)
+            assert isinstance(trace_doc["traceEvents"], list)
+            assert trace_doc["otherData"]["process"] == f"replica:{replica.server_id}"
+
             status, _, body = await loop.run_in_executor(None, _get, port, "/json")
             assert status == 200 and json.loads(body)["hello"] == "mochi-tpu"
 
@@ -259,6 +273,11 @@ async def _fanout_main():
             doc = json.loads(body)
             assert doc["clients"]["quota_refusals"] == 0
             assert "per_replica_quota_refused" in doc["clients"]
+            # round-15: the client shell exports its span ring too
+            assert "trace" in doc and "sample_rate" in doc["trace"]
+            _, ctype, body = await loop.run_in_executor(None, _get, port, "/trace")
+            assert ctype == "application/json"
+            assert isinstance(json.loads(body)["traceEvents"], list)
         finally:
             await cadmin.close()
 

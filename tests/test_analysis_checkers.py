@@ -30,6 +30,7 @@ BAD_EXPECTATIONS = [
     ("protocol-invariants", "invariants_bad.py", 2),
     ("await-races", "await_races_bad.py", 5),
     ("native-const-time", "native_ct_bad.c", 4),
+    ("span-lazy-label", "span_lazy_bad.py", 4),
 ]
 
 
@@ -55,6 +56,7 @@ def test_bad_fixture_trips_checker(rule, filename, expected):
         ("protocol-invariants", "invariants_good.py"),
         ("await-races", "await_races_good.py"),
         ("native-const-time", "native_ct_good.c"),
+        ("span-lazy-label", "span_lazy_good.py"),
     ],
 )
 def test_good_fixture_is_clean(rule, filename):

@@ -46,6 +46,17 @@ def test_run_all_smoke_covers_all_twelve_configs():
         assert "metric" in rec and "value" in rec, (key, rec)
         # the provenance satellite: every record names its host engine
         assert rec.get("host_crypto_engine") in _ENGINES, (key, rec)
+        # round-15 tracing satellite: every record carries a non-empty
+        # trace_summary (tracing is FORCED to sample 1.0 for smoke), and
+        # the configs that drive an in-process cluster must have actually
+        # RECORDED spans — a span-recording seam rotting away fails HERE,
+        # at PR time, not at the next publish battery.
+        ts = rec.get("trace_summary")
+        assert isinstance(ts, dict) and ts, (key, rec)
+        for field in ("enabled", "sample_rate", "spans_recorded"):
+            assert field in ts, (key, ts)
+        if key in ("1", "3", "4", "6", "7", "9", "10", "11"):
+            assert ts["enabled"] and ts["spans_recorded"] > 0, (key, ts)
 
 
 def test_smoke_refuses_publish():

@@ -1,0 +1,270 @@
+"""Round-15 exposition-hygiene satellites: a real parser roundtrips every
+``mochi_*`` family (# HELP/# TYPE present, label values escape-safe even
+for attacker-influenced peer/client ids), and per-identity label
+cardinality is bounded with an ``other`` overflow series."""
+
+from __future__ import annotations
+
+import re
+
+import pytest
+
+from mochi_tpu.admin.http import (
+    PROM_MAX_SERIES,
+    _byzantine_prom,
+    _cap_identities,
+    _clients_prom,
+    _fanout_prom,
+    _num_activity,
+)
+from mochi_tpu.utils.metrics import Metrics, STRAGGLER_BOUNDS_MS
+
+# ------------------------------------------------------------- the parser
+#
+# A faithful subset of the Prometheus text exposition format: enough to
+# parse every line this repo emits and to UNESCAPE label values, so the
+# roundtrip assertion is against parser-visible content, not substrings.
+
+_SAMPLE_RE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)\{(.*)\}\s+(\S+)$")
+
+
+def _unescape(v: str) -> str:
+    out = []
+    i = 0
+    while i < len(v):
+        c = v[i]
+        if c == "\\" and i + 1 < len(v):
+            nxt = v[i + 1]
+            if nxt == "\\":
+                out.append("\\")
+            elif nxt == '"':
+                out.append('"')
+            elif nxt == "n":
+                out.append("\n")
+            else:
+                raise ValueError(f"bad escape \\{nxt}")
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def _parse_labels(raw: str) -> dict:
+    labels = {}
+    i = 0
+    while i < len(raw):
+        eq = raw.index("=", i)
+        name = raw[i:eq]
+        assert raw[eq + 1] == '"', raw
+        j = eq + 2
+        buf = []
+        while raw[j] != '"':
+            if raw[j] == "\\":
+                buf.append(raw[j : j + 2])
+                j += 2
+            else:
+                buf.append(raw[j])
+                j += 1
+        labels[name] = _unescape("".join(buf))
+        i = j + 1
+        if i < len(raw) and raw[i] == ",":
+            i += 1
+    return labels
+
+
+def parse_exposition(body: str):
+    """-> (samples, helped, typed): every sample line parsed, and the
+    family names that carried # HELP / # TYPE headers."""
+    samples = []
+    helped, typed = set(), set()
+    for line in body.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            helped.add(line.split()[2])
+            continue
+        if line.startswith("# TYPE "):
+            typed.add(line.split()[2])
+            continue
+        assert not line.startswith("#"), f"unknown comment line: {line}"
+        m = _SAMPLE_RE.match(line)
+        assert m, f"unparseable sample line: {line!r}"
+        name, raw_labels, value = m.groups()
+        float(value)  # must be numeric
+        samples.append((name, _parse_labels(raw_labels), float(value)))
+    return samples, helped, typed
+
+
+def _family(name: str) -> str:
+    for suffix in ("_bucket", "_sum", "_count"):
+        if name.endswith(suffix) and name != "mochi_timer_count":
+            return name[: -len(suffix)]
+    return name
+
+
+# A deliberately hostile identity: quote, backslash, newline, and brace —
+# everything that breaks naive exposition emitters.
+EVIL_ID = 'peer"x\\y\nz{a="b"}'
+
+
+def test_registry_exposition_roundtrips_every_family():
+    m = Metrics()
+    with m.timer("write-transactions"):
+        pass
+    m.mark("replica.write1-shed", 3)
+    m.mark(f"suspect.bad-grant.{EVIL_ID}", 2)  # attacker-named counter
+    m.set_gauge("overload.load", 0.25)
+    m.histogram("replica.batch-occupancy").observe(4)
+    body = m.to_prometheus({"server": EVIL_ID})
+    samples, helped, typed = parse_exposition(body)
+    assert samples
+    families = {_family(name) for name, _, _ in samples}
+    assert families <= helped, f"missing HELP: {families - helped}"
+    assert families <= typed, f"missing TYPE: {families - typed}"
+    # the hostile strings roundtrip exactly through escape + parse
+    assert any(lab.get("server") == EVIL_ID for _, lab, _ in samples)
+    assert any(
+        lab.get("name") == f"suspect.bad-grant.{EVIL_ID}"
+        for _, lab, _ in samples
+    )
+    by = {
+        (name, lab.get("name", "")): v for name, lab, v in samples
+    }
+    assert by[("mochi_counter_total", f"suspect.bad-grant.{EVIL_ID}")] == 2
+
+
+def test_fanout_family_escapes_and_caps_identities():
+    m = Metrics()
+    m.mark("fanout.early-return", 5)
+    # one hostile peer + a Sybil flood far past the cap
+    m.mark(f"fanout.late-response.{EVIL_ID}", 99)
+    m.histogram(f"fanout-straggler-ms.{EVIL_ID}", STRAGGLER_BOUNDS_MS).observe(2.0)
+    for i in range(PROM_MAX_SERIES * 4):
+        m.mark(f"fanout.straggler-timeout.sybil-{i:04d}")
+    body = _fanout_prom(m, "server", "server-0")
+    samples, helped, typed = parse_exposition(body)
+    assert "mochi_fanout" in helped and "mochi_fanout" in typed
+    peers = {lab["peer"] for _, lab, _ in samples}
+    # bounded: at most the cap (+1 for the aggregate peer="" row)
+    assert len(peers - {""}) <= PROM_MAX_SERIES
+    assert "other" in peers, "overflow identities must fold into 'other'"
+    # the hostile high-activity peer keeps its own (escaped) row
+    assert EVIL_ID in peers
+    # the overflow row carries the folded counts (flood minus kept rows)
+    other_total = sum(
+        v for _, lab, v in samples
+        if lab["peer"] == "other" and lab["stat"] == "straggler_timeout"
+    )
+    kept_sybils = sum(1 for p in peers if p.startswith("sybil-"))
+    assert other_total == PROM_MAX_SERIES * 4 - kept_sybils
+
+
+def test_byzantine_and_client_families_cap_identities():
+    class _StubReplica:
+        server_id = "server-0"
+
+        def byzantine_stats(self):
+            return {
+                "equivocations": {
+                    f"sybil-{i:04d}": 1 for i in range(PROM_MAX_SERIES * 2)
+                },
+                "bad_grants": {EVIL_ID: 7},
+                "resync_bad_certificates": 1,
+            }
+
+        def client_grant_stats(self):
+            return {
+                "quota": 64,
+                "ttl_ms": 1000,
+                "reclaims": 0,
+                "quota_refused": 0,
+                "outstanding_total": 0,
+                "max_wedge_ms": 0.0,
+                "open_wedges": 0,
+                "quota_refusals_served": 0,
+                "banned_clients": 0,
+                "per_client": {
+                    f"client-{i:05d}": {"issued": i, "outstanding": 1}
+                    for i in range(PROM_MAX_SERIES * 3)
+                },
+            }
+
+    r = _StubReplica()
+    samples, helped, typed = parse_exposition(_byzantine_prom(r))
+    assert "mochi_byzantine" in helped and "mochi_byzantine" in typed
+    eq_peers = {
+        lab["peer"] for _, lab, _ in samples if lab["stat"] == "equivocations"
+    }
+    assert len(eq_peers) <= PROM_MAX_SERIES and "other" in eq_peers
+    assert any(lab["peer"] == EVIL_ID for _, lab, _ in samples)
+
+    samples, helped, typed = parse_exposition(_clients_prom(r))
+    assert "mochi_client" in helped and "mochi_client" in typed
+    clients = {lab["client"] for _, lab, _ in samples} - {""}
+    assert len(clients) <= PROM_MAX_SERIES and "other" in clients
+    # highest-activity identities keep their rows; the long tail folds
+    assert f"client-{PROM_MAX_SERIES * 3 - 1:05d}" in clients
+    other_issued = sum(
+        v for _, lab, v in samples
+        if lab["client"] == "other" and lab["stat"] == "issued"
+    )
+    assert other_issued > 0
+
+
+def test_cap_identities_keeps_top_activity_and_merges_other():
+    table = {f"id-{i:03d}": {"n": i} for i in range(PROM_MAX_SERIES + 40)}
+    capped = _cap_identities(table, _num_activity)
+    assert len(capped) == PROM_MAX_SERIES
+    assert "other" in capped
+    # the top-activity identity survives; the least-active folded
+    assert f"id-{PROM_MAX_SERIES + 39:03d}" in capped
+    assert "id-000" not in capped
+    folded = set(table) - set(capped)
+    assert capped["other"]["n"] == sum(int(k[3:]) for k in folded)
+    # under the cap: untouched (no 'other' row manufactured)
+    small = {"a": {"n": 1}, "b": {"n": 2}}
+    assert _cap_identities(small, _num_activity) == small
+
+
+@pytest.mark.parametrize("n_scrapes", [1, 3])
+def test_full_admin_exposition_parses(n_scrapes):
+    """End-to-end: a live replica's whole /metrics.prom body parses and
+    every mochi_* family carries HELP + TYPE."""
+    import asyncio
+
+    from mochi_tpu.admin import AdminServer
+    from mochi_tpu.client.txn import TransactionBuilder
+    from mochi_tpu.testing.virtual_cluster import VirtualCluster
+
+    async def main():
+        async with VirtualCluster(4, rf=4) as vc:
+            client = vc.client()
+            await client.execute_write_transaction(
+                TransactionBuilder().write("pm-k", b"v").build()
+            )
+            admin = AdminServer(vc.replicas[0], port=0)
+            await admin.start()
+            try:
+                import urllib.request
+
+                loop = asyncio.get_running_loop()
+
+                def _get():
+                    with urllib.request.urlopen(
+                        f"http://127.0.0.1:{admin.bound_port}/metrics.prom",
+                        timeout=5,
+                    ) as resp:
+                        return resp.read().decode()
+
+                for _ in range(n_scrapes):
+                    body = await loop.run_in_executor(None, _get)
+                    samples, helped, typed = parse_exposition(body)
+                    families = {_family(name) for name, _, _ in samples}
+                    assert families, "exposition must carry samples"
+                    assert families <= helped, families - helped
+                    assert families <= typed, families - typed
+            finally:
+                await admin.close()
+
+    asyncio.run(asyncio.wait_for(main(), timeout=60))

@@ -93,11 +93,11 @@ def test_cross_shard_commit_survives_replica_kill_mid_write2():
             orig_write2 = MochiDBClient._write2
             killed = []
 
-            async def kill_then_write2(self, transaction, certificate):
+            async def kill_then_write2(self, transaction, certificate, tt=None):
                 if not killed:
                     killed.append(pc.kill_replica(victim))
                     await asyncio.sleep(0.05)  # let the SIGKILL land
-                return await orig_write2(self, transaction, certificate)
+                return await orig_write2(self, transaction, certificate, tt)
 
             client._write2 = kill_then_write2.__get__(client)
             await client.execute_write_transaction(

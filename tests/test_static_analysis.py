@@ -61,6 +61,7 @@ SEEDED = [
     "invariants_bad.py",
     "await_races_bad.py",
     "native_ct_bad.c",
+    "span_lazy_bad.py",
 ]
 
 
