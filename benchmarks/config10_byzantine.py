@@ -31,6 +31,8 @@ transient quorum loss + nudge floods).
 from __future__ import annotations
 
 import asyncio
+import contextlib
+import os
 import time
 from typing import Dict, List, Optional
 
@@ -99,6 +101,65 @@ async def _present_equivocation_evidence(vc, client) -> None:
                 pass  # the presentation is best-effort evidence delivery
 
 
+async def _crash_recover_probe(vc, checker, storage_dir: str) -> Dict:
+    """Round-16 durable posture (ROADMAP item 2 leftover), shared by
+    configs 10 and 11: inside every adversarial leg — hostile replica or
+    hostile coordinator — one HONEST replica additionally crashes and
+    recovers WITH STATE through the round-14 storage engine: flush,
+    freeze the live disk image (WAL, no shutdown snapshot: the crash
+    shape), restart from it with verified replay, delta-resync the gap.
+    The replay conviction counters land in-record (an adversary's
+    validly-signed traffic must never make an honest replica's own log
+    convict on recovery)."""
+    import shutil
+
+    victim = "server-2"  # honest in every leg of both configs
+    old = vc.replica(victim)
+    await old.storage.flush()
+    src = os.path.join(storage_dir, victim)
+    frozen = src + ".crash"
+    shutil.copytree(src, frozen)
+
+    def restore(sid: str) -> None:
+        dst = os.path.join(storage_dir, sid)
+        shutil.rmtree(dst)
+        shutil.move(frozen, dst)
+
+    t0 = time.perf_counter()
+    fresh = await vc.restart_replica(victim, resync=True, before_boot=restore)
+    recover_ms = (time.perf_counter() - t0) * 1e3
+    checker.note_restart(fresh)
+    report = fresh.storage.replay_report()
+    return {
+        "restarted": victim,
+        "replay_entries": report["entries"],
+        "replay_convicted": report["convicted"],
+        "replay_ms": report["ms"],
+        "recover_wall_ms": round(recover_ms, 1),
+    }
+
+
+def _durable_posture_summary(legs) -> Dict:
+    """Roll the per-leg ``durability`` evidence into the record-level
+    verdict (shared by configs 10 and 11)."""
+    durable_legs = [
+        leg for leg in legs if leg.get("durability") is not None
+    ]
+    return {
+        "enabled": bool(durable_legs),
+        "legs_recovered": len(durable_legs),
+        # an adversary's traffic must never make an honest replica's own
+        # WAL convict on recovery — and recovery must actually replay
+        "recovery_convictions_zero_all_legs": all(
+            leg["durability"]["replay_convicted"] == 0 for leg in durable_legs
+        ),
+        "replay_entries_min": min(
+            (leg["durability"]["replay_entries"] for leg in durable_legs),
+            default=0,
+        ),
+    }
+
+
 async def _leg(
     attack: Optional[str],
     n_clients: int,
@@ -107,7 +168,10 @@ async def _leg(
     timeout_s: float,
     drop: float = 0.0,
     trim_write1: bool = False,
+    durable: bool = True,
 ) -> Dict:
+    import tempfile
+
     from mochi_tpu.client.txn import TransactionBuilder
     from mochi_tpu.netsim import NetSim
     from mochi_tpu.testing.invariants import InvariantChecker
@@ -116,7 +180,13 @@ async def _leg(
 
     sim = NetSim.mesh(seed=SEED, rtt_ms=RTT_MS, jitter_ms=JITTER_MS, drop=drop)
     byzantine = {BYZ_SID: attack} if attack else None
-    async with VirtualCluster(5, rf=4, netsim=sim, byzantine=byzantine) as vc:
+    storage_ctx = (
+        tempfile.TemporaryDirectory() if durable else contextlib.nullcontext()
+    )
+    with storage_ctx as storage_dir:
+      async with VirtualCluster(
+          5, rf=4, netsim=sim, byzantine=byzantine, storage_dir=storage_dir
+      ) as vc:
         checker = InvariantChecker(
             vc.honest_replicas(), [BYZ_SID] if attack else []
         )
@@ -212,6 +282,16 @@ async def _leg(
         if attack == "equivocate":
             await _present_equivocation_evidence(vc, clients[0])
 
+        # Durable posture (round 16): kill-and-recover-with-state for one
+        # honest replica inside THIS adversarial leg, conviction counters
+        # in-record, before the acked-durability final check (which then
+        # also covers the recovered replica's serving path).
+        durability = (
+            await _crash_recover_probe(vc, checker, storage_dir)
+            if durable
+            else None
+        )
+
         # Invariant 3 through a workload client: its accrued suspicion is
         # part of the system under test (a fresh client would pay the
         # silent replica's full trim-timeout once per key before its own
@@ -249,6 +329,7 @@ async def _leg(
             "write_failures": write_failures,
             "read_failures": read_failures,
             "wall_s": round(wall, 2),
+            "durability": durability,
             "invariants": checker.report(),
             "evidence": {
                 "suspicion_by_peer": suspicion,
@@ -363,6 +444,7 @@ def run(
     all_safe = honest["invariants"]["ok"] and all(
         leg["invariants"]["ok"] for leg in per_attack.values()
     )
+    durable_posture = _durable_posture_summary((honest, *per_attack.values()))
     worst = max(
         (leg["vs_honest"]["write_p50_ratio"] or 1.0)
         for leg in per_attack.values()
@@ -374,6 +456,7 @@ def run(
         "value": worst,
         "unit": "x honest write p50 (worst attack, 13 ms WAN mesh)",
         "safety_invariants_hold_under_all_attacks": all_safe,
+        "durable_posture": durable_posture,
         "topology": {
             "replicas": 5,
             "rf": 4,

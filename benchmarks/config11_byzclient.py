@@ -31,10 +31,12 @@ grant-hoard leg).
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import time
 from typing import Dict, List, Optional
 
 from .config7_wan import JITTER_MS, RTT_MS, SEED, _pcts
+from .config10_byzantine import _crash_recover_probe, _durable_posture_summary
 
 CLIENT_ATTACKS = ("withhold", "partial-write2", "seed-bias", "grant-hoard")
 
@@ -56,9 +58,12 @@ async def _leg(
     ttl_ms: float,
     quota: int,
     wedge_seeds: int,
+    durable: bool = True,
 ) -> Dict:
     """One honest-writer workload leg (config-10 shape), optionally with a
     Byzantine client attacking the same keys throughout the timed phase."""
+    import tempfile
+
     from mochi_tpu.client.txn import TransactionBuilder
     from mochi_tpu.netsim import NetSim
     from mochi_tpu.testing.invariants import InvariantChecker
@@ -66,8 +71,13 @@ async def _leg(
     from mochi_tpu.utils.runtime import reset_gc_debt
 
     sim = NetSim.mesh(seed=SEED, rtt_ms=RTT_MS, jitter_ms=JITTER_MS)
-    with _defenses(ttl_ms, quota):
-        async with VirtualCluster(5, rf=4, netsim=sim) as vc:
+    storage_ctx = (
+        tempfile.TemporaryDirectory() if durable else contextlib.nullcontext()
+    )
+    with storage_ctx as storage_dir, _defenses(ttl_ms, quota):
+        async with VirtualCluster(
+            5, rf=4, netsim=sim, storage_dir=storage_dir
+        ) as vc:
             checker = InvariantChecker(vc.replicas)
             read_lat: List[float] = []
             write_lat: List[float] = []
@@ -158,6 +168,15 @@ async def _leg(
                 except asyncio.CancelledError:
                     pass
 
+            # Durable posture (round 16): kill-and-recover-with-state for
+            # one honest replica inside this adversarial leg, conviction
+            # counters in-record, before the acked-durability final check.
+            durability = (
+                await _crash_recover_probe(vc, checker, storage_dir)
+                if durable
+                else None
+            )
+
             await checker.final_check(clients[0])
             await checker.stop()
 
@@ -183,6 +202,7 @@ async def _leg(
                 "write_failures": write_failures,
                 "read_failures": read_failures,
                 "wall_s": round(wall, 2),
+                "durability": durability,
                 "invariants": checker.report(),
                 "evidence": {
                     "grant_reclaims": reclaims,
@@ -353,6 +373,7 @@ def run(
     all_safe = honest["invariants"]["ok"] and all(
         leg["invariants"]["ok"] for leg in per_attack.values()
     ) and wedge_on["invariants"]["ok"] and wedge_off["invariants"]["ok"]
+    durable_posture = _durable_posture_summary((honest, *per_attack.values()))
     p95_ms = wedge_on["time_to_conflicting_commit_ms"]["p95"]
     bounded = bool(p95_ms == p95_ms and p95_ms <= 2 * wedge_ttl_ms)
     unbounded_off = (
@@ -369,6 +390,7 @@ def run(
             f"{wedge_ttl_ms:g} ms; TTL off = unbounded)"
         ),
         "safety_invariants_hold_under_all_attacks": all_safe,
+        "durable_posture": durable_posture,
         "acceptance": {
             "ttl_on_p95_bounded_2x_ttl": bounded,
             "ttl_off_unbounded_at_probe_deadline": unbounded_off,

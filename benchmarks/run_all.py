@@ -1,4 +1,4 @@
-"""Run all five BASELINE.json benchmark configs; one JSON line each.
+"""Run all BASELINE.json benchmark configs; one JSON line each.
 
 Usage:
     python -m benchmarks.run_all [config-number ...]
@@ -34,6 +34,7 @@ CONFIG_NAMES = {
     "10": "config10_byzantine",
     "11": "config11_byzclient",
     "12": "config12_durability",
+    "13": "config13_scenario",
 }
 
 # --smoke: tiny-count kwargs per config — a seconds-scale pass whose only
@@ -93,6 +94,15 @@ SMOKE_KWARGS = {
     "12": dict(
         min_acked=6, curve_sizes=(6, 12), gap_writes=2,
         fsync_policies=("group",), fsync_writes=6, timeout_s=4.0,
+    ),
+    # the whole scenario-engine surface in seconds: 2 drawn seeds soaked
+    # in-process, the ×2 determinism probe on a cheap seed, and the full
+    # injected-violation detect→replay→minimize arc — soak numbers at
+    # this count are meaningless; the generator/engine/minimizer APIs and
+    # the record schema are what smoke pins
+    "13": dict(
+        count=2, start=0, workers=1, determinism_seed=4,
+        determinism_runs=2, violation_seed=4,
     ),
 }
 

@@ -158,6 +158,14 @@ class MochiDBClient:
     verify_grant_sigs: bool = field(
         default_factory=lambda: os.environ.get("MOCHI_VERIFY_GRANT_SIGS", "1") != "0"
     )
+    # Deterministic client-side randomness (round 16, scenario engine):
+    # when set, the SDK's RNG — Write1 subEpoch seed draws, shed/refusal
+    # backoff jitter — is random.Random(rng_seed) instead of OS entropy,
+    # so the same seed replays the same draw sequence.  The scenario
+    # engine (testing/scenario.py) derives one per client from the
+    # scenario seed; production callers leave it None (per-process
+    # entropy: correlated backoff jitter across a fleet would herd).
+    rng_seed: Optional[int] = None
     # First-attempt Write1 fan-out trimmed to a quorum (2f+1) instead of the
     # full replica set; retries widen to the full set.  Off by default: it
     # saves f requests per write but measured SLOWER on the single-core
@@ -186,7 +194,11 @@ class MochiDBClient:
         self.tracer = obs_trace.Tracer(
             f"client:{self.netsim_label or self.client_id[:20]}"
         )
-        self._rand = random.Random()
+        self._rand = (
+            random.Random(self.rng_seed)
+            if self.rng_seed is not None
+            else random.Random()
+        )
         # server_id -> session MAC key; Ed25519 envelope signing is the
         # fallback (and the handshake carrier) — crypto/session.py.
         self._sessions: Dict[str, bytes] = {}

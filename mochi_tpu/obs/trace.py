@@ -180,6 +180,50 @@ _GLOBAL = {
 }
 
 
+# ------------------------------------------------------------- run stamp
+#
+# Round 16 (scenario engine): a violation artifact must be SELF-DESCRIBING
+# — a flight dump or invariant report found on disk has to name the seed
+# that regenerates the exact scenario that produced it.  The run stamp is
+# a process-global dict the active harness sets (testing/scenario.py:
+# scenario_seed, generator_version, spec_hash, injected flag); dump_flight
+# merges it into every flight document and InvariantChecker.report()
+# embeds it.  Child server processes inherit it via MOCHI_SCENARIO_SEED /
+# MOCHI_SCENARIO_SPEC_HASH, so cross-process dumps carry the seed too.
+
+_RUN_STAMP: Dict[str, object] = {}
+
+
+def set_run_stamp(**fields) -> None:
+    """Merge fields into the process-global run stamp (None deletes)."""
+    for k, v in fields.items():
+        if v is None:
+            _RUN_STAMP.pop(k, None)
+        else:
+            _RUN_STAMP[k] = v
+
+
+def clear_run_stamp() -> None:
+    _RUN_STAMP.clear()
+
+
+def run_stamp() -> Dict[str, object]:
+    """The current stamp, merged over any env-inherited scenario identity
+    (explicit set_run_stamp fields win).  Empty dict = no harness active."""
+    out: Dict[str, object] = {}
+    raw = os.environ.get("MOCHI_SCENARIO_SEED")
+    if raw:
+        try:
+            out["scenario_seed"] = int(raw)
+        except ValueError:
+            pass
+    h = os.environ.get("MOCHI_SCENARIO_SPEC_HASH")
+    if h:
+        out["spec_hash"] = h
+    out.update(_RUN_STAMP)
+    return out
+
+
 class Tracer:
     """Bounded span recorder for one process role.
 
@@ -379,6 +423,10 @@ class Tracer:
             "reason": reason,
             "at_ms": int(time.time() * 1e3),
             "attach": attach or {},
+            # scenario identity (round 16): the seed/spec-hash that
+            # regenerates the run this evidence came from, when a
+            # harness stamped one — a dump alone is then a reproducer
+            "run": run_stamp(),
             **self.export_chrome(),
         }
         tmp = path + ".tmp"

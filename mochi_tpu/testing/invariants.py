@@ -123,6 +123,24 @@ class InvariantChecker:
         if value is not None:
             self._in_doubt.setdefault(key, set()).add(value)
 
+    def note_restart(self, fresh) -> None:
+        """Swap in the reborn replica object after a ``restart_replica``
+        (round 16, scenario engine): keep sampling the fresh runtime, but
+        forget the OLD incarnation's per-replica progress memory — a
+        recovered replica legally re-derives epochs/timestamps from its
+        certificates (non-durable restarts resync from peers; epochs held
+        only in the grant book do not survive), so comparing the reborn
+        store against the dead one's high-water marks would convict a
+        legal recovery.  Invariant 1's cross-replica/cross-time slot
+        memory is deliberately KEPT: a recovered replica serving a
+        conflicting committed certificate still convicts."""
+        sid = fresh.server_id
+        self.replicas = [
+            fresh if r.server_id == sid else r for r in self.replicas
+        ]
+        for key in [k for k in self._progress if k[0] == sid]:
+            del self._progress[key]
+
     # ------------------------------------------------------------- sampling
 
     # Flight-recorder dumps per run: a conviction storm must write bounded
@@ -305,16 +323,31 @@ class InvariantChecker:
 
         self.check_now()
         for key, value in sorted(self.acked.items()):
-            try:
-                res = await client.execute_read_transaction(
-                    TransactionBuilder().read(key).build()
-                )
-            except asyncio.CancelledError:
-                raise
-            except Exception as exc:
+            # Bounded retry before convicting unreadability: a single
+            # quorum read can time out for reasons durability does not
+            # answer for (host overload stalling 2 of 4 responders past
+            # the client budget — seen live in the round-16 soak, seed
+            # 64).  Retrying IS the system's contract (the SDK's
+            # recovery machinery); a key that stays unreadable through
+            # the retries still convicts.
+            res = None
+            last_exc: Optional[BaseException] = None
+            for attempt in range(3):
+                try:
+                    res = await client.execute_read_transaction(
+                        TransactionBuilder().read(key).build()
+                    )
+                    break
+                except asyncio.CancelledError:
+                    raise
+                except Exception as exc:
+                    last_exc = exc
+                    if attempt < 2:  # no dead sleep after the final try
+                        await asyncio.sleep(0.2 * (attempt + 1))
+            if res is None:
                 self._violate(
                     f"acked write {key!r} unreadable from honest quorum: "
-                    f"{type(exc).__name__}: {exc}"
+                    f"{type(last_exc).__name__}: {last_exc}"
                 )
                 continue
             op = res.operations[0]
@@ -350,7 +383,15 @@ class InvariantChecker:
                 max_wedge_ms, getattr(r.store, "max_wedge_ms", 0.0)
             )
             reclaims += getattr(r.store, "reclaims", 0)
+        # Scenario identity (round 16): when a harness stamped a run
+        # (testing/scenario.py sets seed + generator version + spec hash),
+        # the verdict carries it — a report found in a benchmark record or
+        # CI log then names the seed that regenerates its exact scenario.
+        from ..obs.trace import run_stamp
+
+        stamp = run_stamp()
         return {
+            **({"run": stamp} if stamp else {}),
             "ok": self.ok,
             "samples": self.samples,
             "keys_tracked": len(self.acked),

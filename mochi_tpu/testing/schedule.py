@@ -49,6 +49,7 @@ import functools
 import itertools
 import os
 import random
+import weakref
 from dataclasses import dataclass, field
 from typing import Awaitable, Callable, Iterable, List, Optional, Sequence
 
@@ -77,6 +78,18 @@ class ExplorerLoop(asyncio.SelectorEventLoop):
         self._rng = random.Random(seed)
         self.trace: List[str] = []
         self._task_counter = itertools.count()
+        # Handles whose callback is the LOOP'S OWN bookkeeping (bound
+        # methods of this loop, e.g. ``_sock_write_done`` scheduled as a
+        # sock_connect future's done-callback): these must keep their
+        # exact FIFO slots.  Found by the round-16 scenario engine, which
+        # is the first consumer driving real socket clusters on this
+        # loop: shuffling ``_sock_write_done`` AFTER the task wakeup that
+        # creates the connection's transport makes ``remove_writer`` trip
+        # ``_ensure_fd_no_transport`` ("File descriptor N is used by
+        # transport...") and leaves the connect watcher registered.  The
+        # perturbation thesis is about APPLICATION wake order; the loop's
+        # internal fd bookkeeping is the machinery underneath it.
+        self._internal: "weakref.WeakSet" = weakref.WeakSet()
         self.set_task_factory(self._deterministic_task_factory)
 
     # ---------------------------------------------------------- determinism
@@ -108,7 +121,34 @@ class ExplorerLoop(asyncio.SelectorEventLoop):
 
     # ------------------------------------------------------------ overrides
 
+    def _is_asyncio_internal(self, callback) -> bool:
+        """asyncio's own plumbing — loop fd bookkeeping, transport/stream
+        protocol callbacks like ``SubprocessStreamProtocol.connection_
+        made`` — assumes the FIFO ready order it was written against
+        (e.g. ``_sock_write_done`` before the connect's task wakeup,
+        ``connection_made`` before ``subprocess_exec``'s waiter wakeup).
+        Task wakeups/steps are the exception: they are exactly what the
+        explorer exists to perturb, so they stay shuffled even though
+        they live in ``asyncio.tasks``."""
+        fn = callback
+        while isinstance(fn, functools.partial):
+            fn = fn.func
+        owner = getattr(fn, "__self__", None)
+        if owner is self:
+            return True
+        mod = getattr(fn, "__module__", None) or ""
+        if not mod.startswith("asyncio"):
+            return False
+        return not isinstance(owner, asyncio.Task)
+
     def call_soon(self, callback, *args, context=None):
+        if self._is_asyncio_internal(callback):
+            # untraced AND a shuffle barrier: loop/transport bookkeeping
+            # keeps its FIFO slot (see _internal above) and stays out of
+            # the trace — it is the machinery, not a schedulable wakeup
+            handle = super().call_soon(callback, *args, context=context)
+            self._internal.add(handle)
+            return handle
         return super().call_soon(self._traced(callback), *args, context=context)
 
     def call_at(self, when, callback, *args, context=None):
@@ -121,7 +161,21 @@ class ExplorerLoop(asyncio.SelectorEventLoop):
         if len(ready) > 1:
             batch = list(ready)
             ready.clear()
-            self._rng.shuffle(batch)
+            # Loop-internal bookkeeping handles are BARRIERS: application
+            # callbacks shuffle freely within each segment between them,
+            # but never cross one (a sock_connect's task wakeup scheduled
+            # after ``_sock_write_done`` must stay after it — fd
+            # bookkeeping happens-before the wakeups it unblocks).
+            start = 0
+            for i, h in enumerate(batch):
+                if h in self._internal:
+                    seg = batch[start:i]
+                    self._rng.shuffle(seg)
+                    batch[start:i] = seg
+                    start = i + 1
+            seg = batch[start:]
+            self._rng.shuffle(seg)
+            batch[start:] = seg
             ready.extend(batch)
         super()._run_once()
 

@@ -1,5 +1,5 @@
 """Benchmark-harness smoke (tier-1): ``run_all --smoke`` must produce an
-error-free, provenance-stamped record from ALL 7 configs in seconds.
+error-free, provenance-stamped record from ALL 13 configs in seconds.
 
 This is rot detection, not measurement: a benchmark that imports a moved
 module, calls a renamed API, or drifts its record schema fails HERE, at
@@ -28,8 +28,8 @@ def _run(args, timeout):
     )
 
 
-def test_run_all_smoke_covers_all_twelve_configs():
-    proc = _run(["--smoke"], timeout=600)
+def test_run_all_smoke_covers_all_thirteen_configs():
+    proc = _run(["--smoke"], timeout=700)
     assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-800:]
     recs = [
         json.loads(line)
@@ -37,9 +37,9 @@ def test_run_all_smoke_covers_all_twelve_configs():
         if line.startswith("{")
     ]
     by_config = {r.get("config"): r for r in recs}
-    # configs 1-12: 12 (durable storage) joined in round 14
+    # configs 1-13: 13 (scenario-engine soak) joined in round 16
     assert sorted(by_config, key=int) == [
-        str(i) for i in range(1, 13)
+        str(i) for i in range(1, 14)
     ], sorted(by_config)
     for key, rec in sorted(by_config.items()):
         assert not rec.get("error"), (key, rec)
@@ -55,7 +55,7 @@ def test_run_all_smoke_covers_all_twelve_configs():
         assert isinstance(ts, dict) and ts, (key, rec)
         for field in ("enabled", "sample_rate", "spans_recorded"):
             assert field in ts, (key, ts)
-        if key in ("1", "3", "4", "6", "7", "9", "10", "11"):
+        if key in ("1", "3", "4", "6", "7", "9", "10", "11", "13"):
             assert ts["enabled"] and ts["spans_recorded"] > 0, (key, ts)
 
 

@@ -1,0 +1,393 @@
+"""Tier-1 pins for the deterministic scenario engine (round 16).
+
+What is pinned, and why it is the contract:
+
+* **spec-draw + run determinism** — same seed ⇒ identical drawn spec ⇒
+  byte-identical canonical record ×3 (spec, executed schedule, acked map,
+  invariant verdict).  This is what makes a failing seed a REPRODUCTION.
+* **one small end-to-end scenario per fault family** — crash+restart
+  (durable WAL replay), partition+heal, Byzantine replica, Byzantine
+  client, load spike, live reconfig, and SIGKILL-on-real-processes —
+  each with the invariant verdict held and family-specific evidence
+  asserted (so a family silently degenerating to a no-op fails here).
+* **the violation arc** — an injected store-level conflicting commit is
+  DETECTED, flight-dumped with the scenario seed stamped in, REPLAYED
+  byte-identically from the seed alone (the dump's stamp regenerates the
+  identical spec hash), and MINIMIZED to a strictly smaller spec that
+  still reproduces.
+* **nondeterminism fixes** — the client RNG seed plumbing
+  (``MochiDBClient.rng_seed``) and the ExplorerLoop shuffle-barrier fix
+  (asyncio's fd/pipe bookkeeping keeps FIFO order; shuffling it across a
+  task wakeup corrupted socket connects — found by this engine, the
+  first consumer driving real sockets on the explorer loop).
+* **a smoke-scale soak** (~8 seeds; ``MOCHI_SCENARIO_SEEDS`` widens the
+  slow-marked leg) with zero violations and zero harness errors.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import os
+
+import pytest
+
+from mochi_tpu.testing import scenario
+from mochi_tpu.testing.scenario import ScenarioSpec, draw_spec, run_scenario
+
+
+def _spec(seed: int = 101, faults=(), **kw) -> ScenarioSpec:
+    base = dict(
+        seed=seed,
+        profile="soak",
+        backend="virtual",
+        n_servers=4,
+        rf=4,
+        durable=False,
+        net_seed=seed,
+        rtt_ms=0.0,
+        jitter_ms=0.0,
+        drop=0.0,
+        n_clients=1,
+        keys_per_client=2,
+        sweeps=1,
+        value_bytes=16,
+        timeout_s=2.0,
+        op_attempts=6,
+        faults=tuple(faults),
+    )
+    base.update(kw)
+    return ScenarioSpec(**base)
+
+
+# ---------------------------------------------------------------- determinism
+
+
+def test_spec_draw_is_deterministic_and_json_roundtrips():
+    for seed in (0, 3, 10, 41):
+        a, b = draw_spec(seed), draw_spec(seed)
+        assert a == b
+        assert a.spec_hash() == b.spec_hash()
+        rt = ScenarioSpec.from_json(a.to_json())
+        assert rt == a and rt.spec_hash() == a.spec_hash()
+    assert draw_spec(1).spec_hash() != draw_spec(2).spec_hash()
+
+
+def test_same_seed_three_runs_byte_identical():
+    records = [run_scenario(4).canonical_bytes() for _ in range(3)]
+    assert records[0] == records[1] == records[2]
+    doc = json.loads(records[0])
+    assert doc["verdict"]["ok"] is True
+    assert doc["acked"], "a run with no acked writes pins nothing"
+    assert doc["schedule"][-1] == "final: invariants ok"
+
+
+# ------------------------------------------------------- one leg per family
+
+
+def test_family_crash_restart_durable_replays_wal():
+    spec = _spec(
+        201,
+        durable=True,
+        wal_fsync="off",
+        faults=[{"family": "crash-restart", "victim": "server-1", "resync": True}],
+    )
+    res = run_scenario(spec)
+    assert res.ok, (res.error, res.violations)
+    assert any("restart server-1 convicted=0" in s for s in res.steps), res.steps
+    replays = res.info.get("replays")
+    assert replays and replays[0]["entries"] > 0  # recovery actually replayed
+    assert res.report["storage_replay_convictions"] == 0
+
+
+def test_family_partition_heal_drops_and_recovers():
+    spec = _spec(
+        202,
+        faults=[{"family": "partition-heal", "victim": "server-2", "hold_s": 0.2}],
+    )
+    res = run_scenario(spec)
+    assert res.ok, (res.error, res.violations)
+    assert any("partition server-2" in s for s in res.steps)
+    assert any("heal server-2" in s for s in res.steps)
+    # the partition must have actually eaten frames, or the leg is a no-op
+    assert res.info["netsim_totals"]["dropped"] > 0
+
+
+def test_family_byzantine_replica_invariants_hold():
+    spec = _spec(
+        203,
+        n_servers=5,
+        faults=[{"family": "byz-replica", "sid": "server-1", "strategy": "equivocate"}],
+    )
+    res = run_scenario(spec)
+    assert res.ok, (res.error, res.violations)
+    assert res.report["byzantine_replicas"] == ["server-1"]
+    assert res.report["honest_replicas"] == [
+        f"server-{i}" for i in range(5) if i != 1
+    ]
+
+
+def test_family_byzantine_client_attacks_and_invariants_hold():
+    spec = _spec(
+        204,
+        faults=[
+            {
+                "family": "byz-client",
+                "strategy": "withhold",
+                "seed": 9,
+                "ttl_ms": 300.0,
+                "quota": 64,
+                "wedge_seeds": 32,
+            }
+        ],
+    )
+    res = run_scenario(spec)
+    assert res.ok, (res.error, res.violations)
+    stats = res.info["byz_client_stats"][0]
+    assert stats["strategy"] == "withhold"
+    assert stats["write1_sent"] > 0  # the adversary actually attacked
+
+
+def test_family_load_spike_sheds_absorbed():
+    spec = _spec(205, faults=[{"family": "load-spike", "burst": 8}])
+    res = run_scenario(spec)
+    assert res.ok, (res.error, res.violations)
+    assert any("spike acked=8" in s for s in res.steps)
+    assert len(res.acked) >= 8 + 2 * 2  # spike keys + warm/leg bursts
+
+
+def test_family_reconfig_converges_under_writes():
+    spec = _spec(206, faults=[{"family": "reconfig", "rounds": 1}])
+    res = run_scenario(spec)
+    assert res.ok, (res.error, res.violations)
+    assert any("reconfig configstamp=2" in s for s in res.steps), res.steps
+
+
+def test_family_sigkill_process_cluster_recovers_acked():
+    spec = _spec(
+        207,
+        backend="process",
+        durable=True,
+        wal_fsync="group",
+        keys_per_client=3,
+        timeout_s=8.0,
+        faults=[{"family": "sigkill", "victims": 1, "restart": True}],
+    )
+    res = run_scenario(spec)
+    assert res.ok, (res.error, res.violations)
+    assert any("sigkill server-0" in s for s in res.steps)
+    assert res.report["backend"] == "process"
+    assert res.report["acked_writes"] == len(res.acked) > 0
+
+
+# ------------------------------------------------------------- violation arc
+
+
+def test_injected_violation_detect_dump_replay_minimize(tmp_path):
+    flight = str(tmp_path / "flights")
+    spec = dataclasses.replace(draw_spec(4), inject_violation=True)
+    res = run_scenario(spec, flight_dir=flight)
+    # detected
+    assert not res.ok and res.violations
+    assert "conflicting commits" in res.violations[0]
+    # dumped, with the scenario seed stamped into the artifact
+    dumps = res.info["flight_dumps"]
+    assert dumps, "violation produced no flight dumps"
+    with open(os.path.join(flight, dumps[0])) as fh:
+        doc = json.load(fh)
+    stamp = doc["run"]
+    assert stamp["scenario_seed"] == 4
+    assert stamp["injected"] is True
+    # the dump's stamp regenerates the IDENTICAL spec (repro --seed / --dump)
+    redrawn = dataclasses.replace(
+        draw_spec(stamp["scenario_seed"], stamp["profile"]),
+        inject_violation=stamp["injected"],
+    )
+    assert redrawn.spec_hash() == stamp["spec_hash"] == spec.spec_hash()
+    # replays byte-identically from the seed alone
+    again = run_scenario(redrawn)
+    assert again.canonical_bytes() == res.canonical_bytes()
+    # minimizes to a strictly smaller spec that still reproduces
+    mini = scenario.minimize(spec)
+    assert mini.spec.weight() < spec.weight()
+    still = run_scenario(mini.spec)
+    assert still.violations and "conflicting commits" in still.violations[0]
+    repro = mini.reproducer()
+    assert repro["spec_hash"] == mini.spec.spec_hash()
+
+
+def test_report_carries_run_stamp():
+    from mochi_tpu.obs import trace as obs_trace
+    from mochi_tpu.testing.invariants import InvariantChecker
+
+    try:
+        obs_trace.set_run_stamp(scenario_seed=5, spec_hash="abcd")
+        report = InvariantChecker([]).report()
+        assert report["run"]["scenario_seed"] == 5
+        assert report["run"]["spec_hash"] == "abcd"
+    finally:
+        obs_trace.clear_run_stamp()
+    assert "run" not in InvariantChecker([]).report()
+
+
+# ------------------------------------------------- nondeterminism regressions
+
+
+def test_client_rng_seed_replays_draw_sequence():
+    """The SDK's RNG (Write1 seed draws, backoff jitter) must ride the
+    scenario seed: unseeded OS entropy here made two same-seed scenario
+    runs diverge at the first seed collision/backoff (round-16 fix)."""
+    from mochi_tpu.client.client import MochiDBClient
+    from mochi_tpu.cluster.config import ClusterConfig
+    from mochi_tpu.crypto.keys import generate_keypair
+
+    kps = {f"server-{i}": generate_keypair() for i in range(4)}
+    cfg = ClusterConfig.build(
+        {sid: "127.0.0.1:1" for sid in kps},
+        rf=4,
+        public_keys={sid: kp.public_key for sid, kp in kps.items()},
+    )
+
+    async def draws(rng_seed):
+        client = MochiDBClient(config=cfg, rng_seed=rng_seed)
+        try:
+            return [client._rand.randrange(1000) for _ in range(8)]
+        finally:
+            await client.close()
+
+    async def case():
+        a = await draws(7)
+        b = await draws(7)
+        c = await draws(8)
+        assert a == b, "same rng_seed must replay the same draw sequence"
+        assert a != c
+    asyncio.run(case())
+
+
+def test_explorer_loop_keeps_asyncio_bookkeeping_fifo():
+    """Regression for the shuffle-vs-sock_connect race: the ExplorerLoop
+    reordering ``_sock_write_done`` after the task wakeup that creates
+    the connection's transport raised 'File descriptor N is used by
+    transport ...' inside loop callbacks and left connect watchers
+    registered.  Drive real socket connects on several seeds and assert
+    the loop's exception handler stays silent."""
+    from mochi_tpu.client.txn import TransactionBuilder
+    from mochi_tpu.testing.schedule import ExplorerLoop
+    from mochi_tpu.testing.virtual_cluster import VirtualCluster
+
+    for seed in range(4):
+        loop = ExplorerLoop(seed)
+        asyncio.set_event_loop(loop)
+        errors = []
+        loop.set_exception_handler(
+            lambda l, ctx: errors.append(
+                f"{ctx.get('message')}: {ctx.get('exception')!r}"
+            )
+        )
+
+        async def case():
+            async with VirtualCluster(4, rf=4) as vc:
+                client = vc.client(timeout_s=5.0)
+                await client.execute_write_transaction(
+                    TransactionBuilder().write("fifo-pin", b"v").build()
+                )
+
+        try:
+            loop.run_until_complete(case())
+        finally:
+            asyncio.set_event_loop(None)
+            loop.close()
+        fd_errors = [e for e in errors if "File descriptor" in e]
+        assert not fd_errors, (seed, fd_errors)
+
+
+def test_silent_byzantine_plus_reconfig_converges_honest_only():
+    """Soak-found composition bug (seeds 164/195/275/319/425 of the
+    round-16 bring-up, results_r16.json): the reconfig leg waited for
+    EVERY replica to learn the new configstamp, but a silent adversary
+    never answers the config-resync traffic that would teach it — every
+    silent+reconfig draw wedged at the 15 s convergence deadline.
+    Convergence is only promised for honest replicas."""
+    spec = _spec(
+        208,
+        n_servers=5,
+        faults=[
+            {"family": "byz-replica", "sid": "server-1", "strategy": "silent"},
+            {"family": "reconfig", "rounds": 1},
+        ],
+    )
+    res = run_scenario(spec)
+    assert res.ok, (res.error, res.violations)
+    assert any("reconfig configstamp=2" in s for s in res.steps), res.steps
+
+
+def test_final_check_retries_transient_read_failure():
+    """Soak-found verdict bug (seed 64): ONE un-retried quorum read that
+    timed out under host overload convicted 'acked write unreadable' —
+    a tenancy artifact recorded as durability loss.  final_check now
+    retries (the SDK's recovery machinery is part of the contract); a
+    key that stays unreadable through the retries still convicts."""
+    from mochi_tpu.testing.invariants import InvariantChecker
+
+    class FlakyClient:
+        def __init__(self, fail_times: int):
+            self.fail_times = fail_times
+            self.calls = 0
+
+        async def execute_read_transaction(self, txn):
+            self.calls += 1
+            if self.calls <= self.fail_times:
+                raise TimeoutError("stalled responders")
+
+            class Op:
+                value = b"v"
+                existed = True
+
+            class Res:
+                operations = [Op()]
+
+            return Res()
+
+    async def case():
+        checker = InvariantChecker([])
+        checker.record_ack("k", b"v")
+        flaky = FlakyClient(fail_times=1)
+        await checker.final_check(flaky)
+        assert checker.ok, checker.violations  # one transient → recovered
+        assert flaky.calls == 2
+
+        checker2 = InvariantChecker([])
+        checker2.record_ack("k", b"v")
+        dead = FlakyClient(fail_times=99)
+        await checker2.final_check(dead)
+        assert not checker2.ok  # persistent unreadability still convicts
+        assert "unreadable" in checker2.violations[0]
+
+    asyncio.run(case())
+
+
+# ---------------------------------------------------------------------- soak
+
+
+def test_soak_smoke_eight_seeds():
+    summary = scenario.soak(range(8))
+    assert summary["seeds_run"] == 8
+    assert summary["violations"] == 0, summary["failing_seeds"]
+    assert summary["harness_errors"] == 0, summary["failing_seeds"]
+    assert summary["acked_writes"] > 0
+    # at least a few distinct families drawn even at smoke scale
+    drawn = [f for f, n in summary["fault_family_draws"].items() if n > 0]
+    assert len(drawn) >= 3, summary["fault_family_draws"]
+
+
+@pytest.mark.slow
+def test_soak_slow_wide():
+    count = scenario.soak_seed_count(64)
+    summary = scenario.soak(range(count), workers=2)
+    assert summary["seeds_run"] == count
+    assert summary["violations"] == 0, summary["failing_seeds"]
+    assert summary["harness_errors"] == 0, summary["failing_seeds"]
+    assert all(
+        summary["fault_family_draws"].get(f, 0) > 0 for f in scenario.FAMILIES
+    ), summary["fault_family_draws"]
