@@ -217,6 +217,33 @@ def run_one(key: str, timeout_s: float = 1500.0) -> dict:
     }
 
 
+def _wire_taint_preflight() -> None:
+    """Harness-rot pin (PR 16): --smoke runs in tier-1, so a fast-path PR
+    that renames or bypasses a sanctioned verifier edge without updating
+    the wire-taint registry fails HERE at PR time — the registry-rot
+    finding (or a fresh unverified flow) turns the smoke leg red before
+    any benchmark child spawns.  Same escape hatch as the standing-rules
+    lint gate: MOCHI_SKIP_LINT=1 for forensic re-runs."""
+    if os.environ.get("MOCHI_SKIP_LINT"):
+        return
+    sys.path.insert(0, _REPO)
+    from mochi_tpu.analysis import core as analysis_core
+
+    result = analysis_core.run(
+        [os.path.join(_REPO, "mochi_tpu")], rules=["wire-taint"]
+    )
+    if not result.clean:
+        for finding in result.new:
+            print(" !", finding.render(), file=sys.stderr)
+        print(
+            f"--smoke: wire-taint pass failed ({len(result.new)} finding(s))"
+            " — a fast path must register its verifier edge "
+            "(mochi_tpu/analysis/wire_taint.py; MOCHI_SKIP_LINT=1 overrides)",
+            file=sys.stderr,
+        )
+        sys.exit(4)
+
+
 def main(argv) -> None:
     if argv and argv[0] == "--child":
         _run_child(argv[1])
@@ -229,6 +256,7 @@ def main(argv) -> None:
             sys.exit(2)
         os.environ["MOCHI_BENCH_SMOKE"] = "1"  # children read it
         argv = [a for a in argv if a != "--smoke"]
+        _wire_taint_preflight()
     # --require-tpu: exit 3 unless every config ran on the chip.  The
     # battery banks this step as done-for-the-round on rc==0; without the
     # flag a CPU-fallback run exits 0 (the publish guard only skips

@@ -141,6 +141,21 @@ def main(argv: List[str] | None = None) -> int:
         ),
     )
     parser.add_argument(
+        "--jobs", type=int, default=None, metavar="N",
+        help=(
+            "worker processes for the per-file scan (default: "
+            "MOCHI_ANALYSIS_JOBS, else auto — parallel only on large cold "
+            "runs); results are identical at any setting"
+        ),
+    )
+    parser.add_argument(
+        "--no-cache", action="store_true",
+        help=(
+            "bypass the per-file record cache (also MOCHI_ANALYSIS_CACHE=0); "
+            "results are identical, only the scan is slower"
+        ),
+    )
+    parser.add_argument(
         "--format", choices=("text", "json"), default="text",
     )
     args = parser.parse_args(argv)
@@ -159,6 +174,8 @@ def main(argv: List[str] | None = None) -> int:
             baseline=None if args.write_baseline else baseline,
             scoped=not args.no_path_filter,
             hygiene=not (args.no_hygiene or args.write_baseline),
+            jobs=args.jobs,
+            cache=False if args.no_cache else None,
         )
     except ValueError as exc:
         print(f"error: {exc}", file=sys.stderr)

@@ -41,14 +41,17 @@ the store's batch loop turn are pure in-memory appends.
 from __future__ import annotations
 
 import asyncio
+import hashlib
+import hmac
 import logging
 import os
 import struct
 import time
 import zlib
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from ..protocol import SyncEntry, Transaction, WriteCertificate, transaction_hash
+from ..protocol.codec import encode as _codec_encode
 from ..verifier.spi import VerifyItem
 from . import wal
 from .spi import StorageEngine
@@ -66,6 +69,46 @@ REPLAY_CHUNK = 128
 CONVICTIONS_MAX = 64
 
 FSYNC_POLICIES = ("always", "group", "off")
+
+# Node-local MAC secret for reclaim records (see stage_reclaim).  Commits
+# are self-certifying (the certificate re-verifies at replay); reclaims
+# carry no signature — before this key existed they were adopted on CRC
+# alone, which the wal.py docstring explicitly disclaims as tamper
+# protection.  The wire-taint pass (docs/ANALYSIS.md §wire-taint) convicted
+# exactly that seam: a rewritten reclaim body could poison the reclaimed
+# audit ledger with an arbitrary granted-hash.  The key lives next to the
+# log it authenticates: this defends the log against OFFLINE tampering
+# (edit-the-bytes attacks the CRC invites); an adversary who can also
+# replace the key file — i.e. owns the node — is outside what any
+# node-local secret can address.
+RECLAIM_KEY_FILE = "reclaim.key"
+
+
+def _load_or_create_reclaim_key(directory: str) -> Tuple[bytes, bool]:
+    """Returns ``(key, created)``.  ``created`` means no key predated this
+    boot — the one state in which legacy (pre-MAC, 4-field) reclaim
+    records are still admissible at replay: they were necessarily written
+    before the upgrade.  Once a key exists, every staged reclaim is
+    MAC'd, so an unMAC'd record under an existing key is tampering."""
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, RECLAIM_KEY_FILE)
+    try:
+        with open(path, "rb") as fh:
+            key = fh.read()
+        if len(key) >= 16:
+            return key, False
+    except OSError:
+        pass
+    key = os.urandom(32)
+    tmp = f"{path}.tmp{os.getpid()}"
+    fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    try:
+        os.write(fd, key)
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+    os.replace(tmp, path)
+    return key, True
 
 
 def _env_policy(value: Optional[str]) -> str:
@@ -126,6 +169,9 @@ class DurableStorage(StorageEngine):
             else int(os.environ.get("MOCHI_WAL_SNAPSHOT_BYTES", str(64 << 20)))
         )
         self.snapshot_path = os.path.join(directory, "snapshot.bin")
+        self._reclaim_key, self._reclaim_key_created = (
+            _load_or_create_reclaim_key(directory)
+        )
         # staged-but-unwritten frames (encoded on the store's loop turn —
         # native mcode, cheap — so the executor write is pure IO)
         self._staged: List[bytes] = []
@@ -188,15 +234,39 @@ class DurableStorage(StorageEngine):
     def stage_reclaim(
         self, key: str, ts: int, granted_hash: bytes, new_epoch: int
     ) -> None:
+        """Reclaims are the one record kind with no certificate to re-verify
+        at replay, so each body carries a node-keyed MAC (bound to the
+        record's sequence number — a relocated copy fails too); replay
+        re-verifies it via :meth:`_reclaim_auth_ok` before the epoch bump
+        and ledger write are adopted."""
         if self._replaying or self._closed:
             return
         self._seq += 1
+        mac = self._reclaim_mac(self._seq, key, ts, granted_hash, new_epoch)
         frame = wal.encode_record(
-            self._seq, wal.RT_RECLAIM, [key, ts, granted_hash, new_epoch]
+            self._seq, wal.RT_RECLAIM, [key, ts, granted_hash, new_epoch, mac]
         )
         self._staged.append(frame)
         self.wal_entries += 1
         self.wal_bytes += len(frame)
+
+    def _reclaim_mac(
+        self, seq: int, key: str, ts: int, granted_hash: bytes, new_epoch: int
+    ) -> bytes:
+        msg = _codec_encode(
+            [int(seq), str(key), int(ts), bytes(granted_hash), int(new_epoch)]
+        )
+        return hmac.new(self._reclaim_key, msg, hashlib.sha256).digest()
+
+    def _reclaim_auth_ok(
+        self, seq: int, key: str, ts: int, granted_hash: bytes,
+        new_epoch: int, mac: bytes
+    ) -> bool:
+        """Sanctioned ``wal``-class verifier edge (wire-taint registry):
+        everything a reclaim record contributes to the store is admitted
+        only through this check."""
+        want = self._reclaim_mac(seq, key, ts, granted_hash, new_epoch)
+        return hmac.compare_digest(want, bytes(mac))
 
     @property
     def dirty(self) -> bool:
@@ -623,12 +693,33 @@ class DurableStorage(StorageEngine):
 
     def _replay_reclaim(self, store, rec) -> None:
         try:
-            key, ts, granted_hash, new_epoch = rec.body
+            if len(rec.body) == 5:
+                key, ts, granted_hash, new_epoch, mac = rec.body
+                mac = bytes(mac)
+            else:
+                key, ts, granted_hash, new_epoch = rec.body
+                mac = None
             ts = int(ts)
             new_epoch = int(new_epoch)
             granted_hash = bytes(granted_hash)
         except Exception:
             self._convict(rec.seq, None, None, "undecodable reclaim body")
+            return
+        if mac is None:
+            # Legacy pre-MAC record.  Acceptable only if no reclaim key
+            # predated this boot (the log necessarily predates the upgrade);
+            # once a key exists, every genuine record carries a MAC and a
+            # bare body is tampering.
+            if not self._reclaim_key_created:
+                self._convict(rec.seq, key, None, "reclaim missing MAC")
+                return
+            self._replay["legacy_reclaims"] = (
+                int(self._replay.get("legacy_reclaims", 0)) + 1
+            )
+        elif not self._reclaim_auth_ok(
+            rec.seq, key, ts, granted_hash, new_epoch, mac
+        ):
+            self._convict(rec.seq, key, None, "reclaim MAC mismatch")
             return
         sv = store._get_or_create(key)
         if new_epoch > sv.current_epoch:

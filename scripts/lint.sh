@@ -1,5 +1,10 @@
 #!/usr/bin/env bash
 # Project-native static analysis over the production tree (docs/ANALYSIS.md).
+# Includes the wire-taint verification-boundary pass (PR 16): every protocol
+# decision must be anchored to verified bytes, and a fast path that removes a
+# verification step must register its replacement verifier edge in
+# mochi_tpu/analysis/wire_taint.py — this gate (and the registry-rot
+# tripwire) is what fails the PR otherwise.
 #
 # Usage: scripts/lint.sh [GIT_REF]
 #   no ref -> full-strict: ANY new finding exits 1 (fix it or add a justified

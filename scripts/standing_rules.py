@@ -26,6 +26,10 @@ def lint_gate() -> None:
     blocking call on the loop, a host sync in traced code) is a measurement
     of the *bug*, not the system — DSig (arXiv:2406.07215) shows exactly
     these signature-path micro-regressions dominating BFT tail latency.
+    The full pass includes the wire-taint verification-boundary checker
+    (PR 16), so a benchmark capture on a tree whose fast path bypassed the
+    verifier registry — i.e. whose perf numbers come from skipping
+    verification the protocol's safety argument requires — is refused too.
     Same pass as scripts/lint.sh / tier-1 (docs/ANALYSIS.md); escape hatch
     for forensic re-runs: MOCHI_SKIP_LINT=1.
     """

@@ -1,0 +1,35 @@
+"""Seeded unbounded-growth regressions: per-identity keyed containers
+with no eviction anywhere in the class (the SessionTable/client_stats/
+ban-book bug class PRs 8/9 fixed by hand)."""
+
+from collections import defaultdict, deque
+
+
+class LeakyTable:
+    def __init__(self):
+        self.sessions = {}
+        self.stats = defaultdict(int)
+        self.backlog = deque()
+
+    # 1. dict subscript keyed straight off a request parameter
+    def open_session(self, client_id, session):
+        self.sessions[client_id] = session
+
+    # 2. defaultdict grown via a name derived from a parameter
+    def record(self, envelope):
+        cid = envelope.client_id
+        self.stats[cid] = self.stats.get(cid, 0) + 1
+
+    # 3. capless deque .append of per-request data
+    def enqueue(self, frame):
+        self.backlog.append(frame)
+
+
+class LoopDerived:
+    def __init__(self):
+        self.seen = {}
+
+    # 4. key bound by iterating a parameter (transitive derivation)
+    def absorb(self, batch):
+        for env in batch:
+            self.seen[env.msg_id] = env

@@ -1,0 +1,47 @@
+"""Mirror of unbounded_growth_bad.py: every container either shows
+eviction evidence or is bounded by construction — all clean."""
+
+from collections import deque
+
+
+class CappedTable:
+    def __init__(self):
+        self.sessions = {}
+        self.stats = {}
+        self.backlog = deque(maxlen=1024)  # bounded by construction
+        self.ring = []
+
+    # len() cap check is eviction evidence
+    def open_session(self, client_id, session):
+        if len(self.sessions) >= 4096:
+            self.sessions.pop(next(iter(self.sessions)))
+        self.sessions[client_id] = session
+
+    # explicit del elsewhere in the class counts for the whole attr
+    def record(self, envelope):
+        cid = envelope.client_id
+        self.stats[cid] = self.stats.get(cid, 0) + 1
+
+    def forget(self, cid):
+        del self.stats[cid]
+
+    def enqueue(self, frame):
+        self.backlog.append(frame)
+
+    # rotation (reassignment outside __init__) is eviction evidence
+    def absorb(self, batch):
+        for env in batch:
+            self.ring.append(env)
+        self.ring = self.ring[-256:]
+
+
+class NotPerRequest:
+    def __init__(self, server_ids):
+        self.peers = {}
+        # growth in __init__ is setup, not per-request
+        for sid in server_ids:
+            self.peers[sid] = None
+
+    # growth keyed by a constant, not request-derived data: clean
+    def mark(self, flag):
+        self.peers["local"] = True

@@ -1,0 +1,45 @@
+"""Seeded wire-taint regressions: every block reaches a protocol-decision
+sink with a wire-tainted value and no sanctioned verifier edge on the
+path.  tests/test_analysis_checkers.py pins the exact conviction count;
+tests/test_static_analysis.py runs the file through the CLI exit-code
+gate.  Mirror image of wire_taint_good.py (same flows, verifiers added).
+"""
+
+from mochi_tpu.protocol import codec  # noqa: F401  (patterns are suffix-matched)
+
+
+class BadReplica:
+    # 1. direct: decoded envelope straight into the write1 apply
+    def on_frame(self, frame, store):
+        env = codec.decode_env(frame)
+        return store.process_write1(env)
+
+    # 2. entry edge: handle_batch params arrive off the transport tainted
+    async def handle_batch(self, envs, store):
+        for env in envs:
+            store.process_read(env)
+
+    # 3. interprocedural: the taint crosses a helper's return value
+    def _pull(self, sock):
+        resp = sock.send_and_receive(b"req")
+        return resp
+
+    def on_reply(self, sock):
+        resp = self._pull(sock)
+        self._tally_write2(resp)
+
+    # 4. attr-store sink: WAL records into the reclaimed ledger unverified
+    def replay(self, directory):
+        for rec in iter_log(directory, "s1"):
+            key, ts, gh, epoch = rec.body
+            self.reclaimed[(key, ts)] = gh
+
+    # 5. CNF partial: _grant_ok confers cert but grant-subset also
+    #    demands env (the envelope MAC gate was skipped)
+    def assemble(self, transaction, payloads):
+        oks = []
+        for p in payloads:
+            mg = from_obj(p)
+            if self._grant_ok(mg, transaction):
+                oks.append(mg)
+        return self._quorum_grant_subset(transaction, oks)

@@ -1,0 +1,53 @@
+"""Mirror of wire_taint_bad.py with the sanctioned verifier edges in
+place: every flow below is clean, and the seeded mutation sweep in
+tests/test_wire_taint_fixes.py proves non-vacuity by deleting one
+verifier call per seed and requiring the pass to convict the sink."""
+
+from mochi_tpu.protocol import codec  # noqa: F401
+
+
+class GoodReplica:
+    # 1. envelope MAC gate before the write1 apply
+    def on_frame(self, frame, store):
+        env = codec.decode_env(frame)
+        if not self._auth_mac(env):
+            return None
+        return store.process_write1(env)
+
+    # 2. entry edge params verified before the read apply
+    async def handle_batch(self, envs, store):
+        for env in envs:
+            if not self._auth_mac(env):
+                continue
+            store.process_read(env)
+
+    # 3. interprocedural: the helper's caller authenticates the response
+    def _pull(self, sock):
+        resp = sock.send_and_receive(b"req")
+        return resp
+
+    def on_reply(self, sock):
+        resp = self._pull(sock)
+        if not self._authentic(resp):
+            return
+        self._tally_write2(resp)
+
+    # 4. reclaim records re-authenticated before the ledger write
+    def replay(self, directory):
+        for rec in iter_log(directory, "s1"):
+            key, ts, gh, epoch, mac = rec.body
+            if not self._reclaim_auth_ok(rec.seq, key, ts, gh, epoch, mac):
+                continue
+            self.reclaimed[(key, ts)] = gh
+
+    # 5. full CNF: envelope auth AND per-grant verification before the
+    #    certificate subset is assembled
+    def assemble(self, transaction, payloads):
+        oks = []
+        for p in payloads:
+            mg = from_obj(p)
+            if not self._authentic(mg):
+                continue
+            if self._grant_ok(mg, transaction):
+                oks.append(mg)
+        return self._quorum_grant_subset(transaction, oks)

@@ -31,6 +31,8 @@ BAD_EXPECTATIONS = [
     ("await-races", "await_races_bad.py", 5),
     ("native-const-time", "native_ct_bad.c", 4),
     ("span-lazy-label", "span_lazy_bad.py", 4),
+    ("wire-taint", "wire_taint_bad.py", 5),
+    ("unbounded-growth", "unbounded_growth_bad.py", 4),
 ]
 
 
@@ -57,6 +59,8 @@ def test_bad_fixture_trips_checker(rule, filename, expected):
         ("await-races", "await_races_good.py"),
         ("native-const-time", "native_ct_good.c"),
         ("span-lazy-label", "span_lazy_good.py"),
+        ("wire-taint", "wire_taint_good.py"),
+        ("unbounded-growth", "unbounded_growth_good.py"),
     ],
 )
 def test_good_fixture_is_clean(rule, filename):

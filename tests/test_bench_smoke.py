@@ -63,3 +63,37 @@ def test_smoke_refuses_publish():
     proc = _run(["--smoke", "--publish"], timeout=60)
     assert proc.returncode == 2
     assert "meaningless" in proc.stderr
+
+
+def test_smoke_wire_taint_preflight_passes_on_clean_tree():
+    # the preflight itself (PR 16): a clean tree sails through — no exit
+    from benchmarks.run_all import _wire_taint_preflight
+
+    _wire_taint_preflight()
+
+
+def test_smoke_wire_taint_preflight_blocks_dirty_tree(monkeypatch, capsys):
+    """A fast-path PR that bypasses the verifier registry must fail the
+    smoke leg at PR time: a wire-taint finding (registry-rot or a fresh
+    unverified flow) exits 4 before any benchmark child spawns."""
+    import pytest
+
+    import mochi_tpu.analysis.core as analysis_core
+    from benchmarks.run_all import _wire_taint_preflight
+
+    dirty = analysis_core.RunResult(
+        new=[
+            analysis_core.Finding(
+                "wire-taint", "mochi_tpu/server/replica.py", 1, 0,
+                "registry-rot: sanctioned edge 'session-mac' matched no "
+                "call site",
+                snippet="registry-rot:session-mac",
+            )
+        ]
+    )
+    monkeypatch.setattr(analysis_core, "run", lambda *a, **k: dirty)
+    monkeypatch.delenv("MOCHI_SKIP_LINT", raising=False)
+    with pytest.raises(SystemExit) as exc:
+        _wire_taint_preflight()
+    assert exc.value.code == 4
+    assert "register its verifier edge" in capsys.readouterr().err

@@ -62,6 +62,8 @@ SEEDED = [
     "await_races_bad.py",
     "native_ct_bad.c",
     "span_lazy_bad.py",
+    "wire_taint_bad.py",
+    "unbounded_growth_bad.py",
 ]
 
 
