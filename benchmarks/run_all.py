@@ -35,6 +35,7 @@ CONFIG_NAMES = {
     "11": "config11_byzclient",
     "12": "config12_durability",
     "13": "config13_scenario",
+    "14": "config14_pagedstore",
 }
 
 # --smoke: tiny-count kwargs per config — a seconds-scale pass whose only
@@ -103,6 +104,16 @@ SMOKE_KWARGS = {
     "13": dict(
         count=2, start=0, workers=1, determinism_seed=4,
         determinism_runs=2, violation_seed=4,
+    ),
+    # the whole paged-engine surface in seconds: one tiny direct-engine
+    # rung per engine (load -> flush -> fault-in reads -> cold recovery),
+    # one real-process SIGKILL -> restart -> readback pass on the paged
+    # engine, and the page-tamper conviction leg — curve numbers at these
+    # counts are noise; the record schema + acceptance booleans are what
+    # smoke pins
+    "14": dict(
+        rungs=(64,), ab_rungs=(64,), value_bytes=64, reads=32,
+        min_acked=6, timeout_s=4.0,
     ),
 }
 

@@ -246,7 +246,29 @@ def register_edge(edge: Edge) -> Edge:
     return edge
 
 
+# Modules that register runtime edges at import time.  The analysis runs
+# in whatever process invoked it (CLI, bench preflight, tests) — without
+# loading these, a fast-path module's registered edges would be invisible
+# to any scan that didn't happen to import the module first, and its
+# sanctioned sanitizer would read as an unregistered check (a false
+# finding) or, worse, its expect_live pin would silently not apply.
+_EDGE_PROVIDERS = ("mochi_tpu.storage.paged",)
+_providers_loaded = False
+
+
+def _load_edge_providers() -> None:
+    global _providers_loaded
+    if _providers_loaded:
+        return
+    _providers_loaded = True
+    import importlib
+
+    for mod in _EDGE_PROVIDERS:
+        importlib.import_module(mod)
+
+
 def registered_edges() -> Tuple[Edge, ...]:
+    _load_edge_providers()
     return BUILTIN_EDGES + tuple(_RUNTIME_EDGES)
 
 

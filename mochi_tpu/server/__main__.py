@@ -136,7 +136,8 @@ async def amain(args) -> None:
             from ..storage import build_storage
 
             storage = build_storage(
-                args.storage_dir, sid, fsync=args.wal_fsync
+                args.storage_dir, sid, fsync=args.wal_fsync,
+                engine=args.storage_engine,
             )
         replica_cls = MochiReplica
         replica_kwargs = {}
@@ -285,6 +286,16 @@ def main(argv=None) -> None:
         "verified crash recovery under <dir>/<server-id>; "
         "docs/OPERATIONS.md §4i).  Orthogonal to --data-dir's legacy "
         "whole-store snapshots",
+    )
+    parser.add_argument(
+        "--storage-engine",
+        choices=("wal", "paged"),
+        default=None,
+        help="durable engine under --storage-dir (default: "
+        "MOCHI_STORAGE_ENGINE or 'wal'): wal = whole-store snapshots, "
+        "everything resident (§4i); paged = immutable self-certifying "
+        "value pages + bounded resident cache, keyspace can exceed RAM "
+        "(docs/OPERATIONS.md §4l)",
     )
     parser.add_argument(
         "--wal-fsync",

@@ -127,11 +127,14 @@ class MochiReplica:
         netsim=None,
         # Durable storage (round 14, mochi_tpu/storage; docs/OPERATIONS.md
         # §4i): ``storage`` takes a ready StorageEngine; ``storage_dir``
-        # builds a DurableStorage rooted at <dir>/<server_id> (WAL +
+        # builds a durable engine rooted at <dir>/<server_id> (WAL +
         # snapshots + verified crash recovery).  Neither -> MemoryStorage,
         # the reference's in-memory posture and the test-matrix default.
+        # ``storage_engine`` picks which durable engine a storage_dir gets:
+        # "wal" (default) or "paged" (round 17, docs/OPERATIONS.md §4l).
         storage=None,
         storage_dir: Optional[str] = None,
+        storage_engine: Optional[str] = None,
     ):
         self.server_id = server_id
         self.config = config
@@ -156,7 +159,10 @@ class MochiReplica:
         if storage is None:
             from ..storage import build_storage
 
-            storage = build_storage(storage_dir, server_id, metrics=self.metrics)
+            storage = build_storage(
+                storage_dir, server_id, metrics=self.metrics,
+                engine=storage_engine,
+            )
         elif getattr(storage, "metrics", None) is None:
             # an engine built before the replica existed (server boot path)
             # adopts this replica's registry for its fsync/snapshot evidence
@@ -281,7 +287,7 @@ class MochiReplica:
         await self.storage.start()
         await self.rpc.start()
         if self.snapshot_interval_s > 0 and (
-            self.snapshot_path or self.storage.name == "durable"
+            self.snapshot_path or self.storage.name in ("durable", "paged")
         ):
             self._snapshot_task = asyncio.ensure_future(self._snapshot_loop())
 
@@ -337,7 +343,7 @@ class MochiReplica:
 
         while True:
             await asyncio.sleep(self.snapshot_interval_s)
-            if self.storage.name == "durable":
+            if self.storage.name in ("durable", "paged"):
                 try:
                     # the engine snapshots + truncates its own WAL (and
                     # also self-triggers on log growth); the legacy

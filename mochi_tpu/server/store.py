@@ -506,14 +506,21 @@ class DataStore:
         return self.data_config if key.startswith(CONFIG_KEY_PREFIX) else self.data
 
     def _get(self, key: str) -> Optional[StoreValue]:
-        return self._map_for(key).get(key)
-
-    def _get_or_create(self, key: str) -> StoreValue:
         m = self._map_for(key)
         sv = m.get(key)
+        eng = self.storage
+        if eng is not None and eng.pager and m is self.data:
+            if sv is None:
+                sv = eng.fault_in(self, key)
+            else:
+                eng.note_access(key)
+        return sv
+
+    def _get_or_create(self, key: str) -> StoreValue:
+        sv = self._get(key)
         if sv is None:
             sv = StoreValue(key)
-            m[key] = sv
+            self._map_for(key)[key] = sv
         return sv
 
     def owns(self, key: str) -> bool:
@@ -1147,9 +1154,12 @@ class DataStore:
         (data + ``_CONFIG_``) are covered.
         """
         if keys is None:
-            candidates: Iterable[str] = sorted(
-                list(self.data.keys()) + list(self.data_config.keys())
-            )
+            names = set(self.data) | set(self.data_config)
+            if self.storage is not None and self.storage.pager:
+                # evicted keys still have exportable commit history on
+                # disk; _get below faults each one in through the engine
+                names |= set(self.storage.paged_keys())
+            candidates: Iterable[str] = sorted(names)
         else:
             candidates = sorted(keys)
         out: List[SyncEntry] = []
@@ -1184,6 +1194,15 @@ class DataStore:
                 if sv.last_transaction is None or sv.current_certificate is None:
                     continue
                 txh = transaction_hash(sv.last_transaction)
+                yield key, self.config.token_for_key(key), self.key_digest(key, txh)
+        if self.storage is not None and self.storage.pager:
+            # evicted keys digest from the page index's footer txh — no
+            # fault-in (anti-entropy over a paged keyspace must not drag
+            # the whole store resident); a tampered footer txh can at
+            # worst force a digest mismatch, i.e. a resync repair
+            for key, txh in self.storage.iter_evicted_digests(
+                self.data, self.data_config
+            ):
                 yield key, self.config.token_for_key(key), self.key_digest(key, txh)
 
     def export_shard_digests(self) -> List[List[object]]:

@@ -6,14 +6,20 @@ of self-certifying write certificates, group-commit fsync policies,
 snapshots with log truncation, and crash recovery that re-verifies every
 replayed certificate through the batch signature path (tampered logs are
 convicted, never adopted).  See docs/OPERATIONS.md §4i.
+``PagedStorage`` (round 17, ``MOCHI_STORAGE_ENGINE=paged``) keeps the
+same WAL tail but pages committed values to immutable self-certifying
+page files with a bounded resident cache — the keyspace outgrows RAM.
+See docs/OPERATIONS.md §4l.
 """
 
 from .durable import DurableStorage
+from .paged import PagedStorage
 from .spi import MemoryStorage, StorageEngine, build_storage
 
 __all__ = [
     "StorageEngine",
     "MemoryStorage",
     "DurableStorage",
+    "PagedStorage",
     "build_storage",
 ]

@@ -26,6 +26,16 @@ Engines:
 * :class:`~mochi_tpu.storage.durable.DurableStorage` — the log-structured
   engine (WAL + snapshots + verified recovery), opted into via
   ``MochiReplica(storage_dir=...)`` / ``--storage-dir``.
+* :class:`~mochi_tpu.storage.paged.PagedStorage` — the paged engine (WAL
+  tail + immutable self-certifying value pages + bounded resident cache),
+  selected with ``MOCHI_STORAGE_ENGINE=paged`` / ``--storage-engine paged``
+  once a storage dir is configured.
+
+Paging engines (``pager = True``) additionally serve the READ path:
+``DataStore._get`` calls ``fault_in(store, key)`` on a resident miss and
+``note_access(key)`` on a resident hit, so a keyspace larger than RAM
+stays addressable — values come back from disk on demand, re-checked
+per entry before adoption.
 """
 
 from __future__ import annotations
@@ -44,6 +54,17 @@ class StorageEngine:
     """
 
     name = "none"
+    # Paging engines override these: pager=True opts the store's read path
+    # into fault_in/note_access dispatch (see module docstring).
+    pager = False
+
+    def fault_in(self, store, key: str):
+        """On-demand load of a non-resident key; returns the StoreValue
+        now resident (and adopted into ``store``) or None."""
+        return None
+
+    def note_access(self, key: str) -> None:
+        """Resident-hit notification (cache recency bookkeeping)."""
 
     # ------------------------------------------------------------- staging
 
@@ -97,22 +118,36 @@ class MemoryStorage(StorageEngine):
     name = "memory"
 
 
+STORAGE_ENGINES = ("wal", "paged")
+
+
 def build_storage(
     storage_dir: Optional[str],
     server_id: str,
     fsync: Optional[str] = None,
     metrics=None,
+    engine: Optional[str] = None,
 ) -> StorageEngine:
-    """``storage_dir`` -> a DurableStorage rooted at ``<dir>/<server_id>``
+    """``storage_dir`` -> a durable engine rooted at ``<dir>/<server_id>``
     (per-replica isolation under one operator-supplied root); None -> the
-    in-memory no-op."""
+    in-memory no-op.  ``engine`` (or ``MOCHI_STORAGE_ENGINE``) picks which
+    durable engine: ``wal`` (default — whole-store snapshots, everything
+    resident) or ``paged`` (value pages + bounded resident cache)."""
     if not storage_dir:
         return MemoryStorage()
     import os
 
+    engine = (engine or os.environ.get("MOCHI_STORAGE_ENGINE", "wal")).lower()
+    if engine not in STORAGE_ENGINES:
+        raise ValueError(
+            f"MOCHI_STORAGE_ENGINE must be one of {STORAGE_ENGINES}, "
+            f"got {engine!r}"
+        )
+    directory = os.path.join(storage_dir, server_id)
+    if engine == "paged":
+        from .paged import PagedStorage
+
+        return PagedStorage(directory, server_id, fsync=fsync, metrics=metrics)
     from .durable import DurableStorage
 
-    return DurableStorage(
-        os.path.join(storage_dir, server_id), server_id, fsync=fsync,
-        metrics=metrics,
-    )
+    return DurableStorage(directory, server_id, fsync=fsync, metrics=metrics)

@@ -146,6 +146,9 @@ class ProcessCluster:
         # real processes.  ``wal_fsync`` forwards --wal-fsync.
         storage_dir=None,
         wal_fsync: Optional[str] = None,
+        # forwards --storage-engine ("wal"/"paged"); None defers to the
+        # child's MOCHI_STORAGE_ENGINE (or "wal")
+        storage_engine: Optional[str] = None,
     ):
         if n_processes is None:
             n_processes = min(n_servers, os.cpu_count() or 1)
@@ -167,6 +170,7 @@ class ProcessCluster:
         self.byzantine: Dict[str, str] = dict(byzantine or {})
         self.storage_dir = storage_dir
         self.wal_fsync = wal_fsync
+        self.storage_engine = storage_engine
         # resolved at start(): True -> <tmpdir>/storage, str -> that path
         self.storage_root: Optional[str] = None
         self._extra_env = dict(env or {})
@@ -297,6 +301,8 @@ class ProcessCluster:
                     argv += ["--storage-dir", self.storage_root]
                     if self.wal_fsync:
                         argv += ["--wal-fsync", self.wal_fsync]
+                    if self.storage_engine:
+                        argv += ["--storage-engine", self.storage_engine]
                 sp.argv = argv
                 log = await loop.run_in_executor(None, open, sp.log_path, "ab")
                 try:

@@ -77,7 +77,10 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
-GENERATOR_VERSION = 1
+# v2: durable draws gained the ``engine`` dimension (wal vs paged, round
+# 17) — a new "engine" stream, so v1 seeds draw identical topologies and
+# faults, but the spec shape changed and pinned specs re-pin.
+GENERATOR_VERSION = 2
 
 # The fault families a seed can draw.  "sigkill" only appears on the
 # process backend (a real SIGKILL needs a real process); everything else
@@ -149,6 +152,9 @@ class ScenarioSpec:
     rf: int = 4
     durable: bool = False
     wal_fsync: str = "group"
+    # which durable engine the storage dir gets ("wal" | "paged", round
+    # 17); meaningless unless durable
+    engine: str = "wal"
     # netsim shape (the LinkEvent schedule is implied by the fault legs —
     # the engine fires partition/heal/degrade events at leg barriers)
     net_seed: int = 0
@@ -204,6 +210,7 @@ class ScenarioSpec:
             + self.keys_per_client
             + self.sweeps
             + (2 if self.durable else 0)
+            + (1 if self.engine != "wal" else 0)
             + (1 if self.rtt_ms > 0 else 0)
             + (1 if self.drop > 0 else 0)
         )
@@ -225,9 +232,13 @@ def draw_spec(seed: int, profile: str = "soak") -> ScenarioSpec:
     net_rng = _stream(seed, "netsim")
     fault_rng = _stream(seed, "faults")
     wl_rng = _stream(seed, "workload")
+    # Separate stream (not a draw on topo_rng): existing components keep
+    # their exact v1 draws — the engine dimension is purely additive.
+    engine_rng = _stream(seed, "engine")
 
     # ~1 in 8 seeds buys a real-process SIGKILL scenario: OS processes,
-    # durable WAL, kill -9 the whole cluster mid-load, recover from disk.
+    # durable storage, kill -9 the whole cluster mid-load, recover from
+    # disk — half of them against the paged engine (round 17).
     if backend_rng.random() < 0.125:
         victims = 1 + backend_rng.randrange(2)
         return ScenarioSpec(
@@ -238,6 +249,7 @@ def draw_spec(seed: int, profile: str = "soak") -> ScenarioSpec:
             rf=4,
             durable=True,
             wal_fsync="group",
+            engine=engine_rng.choice(("wal", "paged")),
             n_clients=1,
             keys_per_client=3 + wl_rng.randrange(3),
             sweeps=1,
@@ -252,6 +264,7 @@ def draw_spec(seed: int, profile: str = "soak") -> ScenarioSpec:
     n_servers, rf = topo_rng.choice(((4, 4), (5, 4), (5, 4), (6, 4)))
     durable = topo_rng.random() < 0.35
     wal_fsync = topo_rng.choice(("group", "off")) if durable else "group"
+    engine = engine_rng.choice(("wal", "paged")) if durable else "wal"
 
     rtt_ms = net_rng.choice((0.0, 0.0, 2.0, 4.0, 8.0))
     jitter_ms = round(rtt_ms / 8.0, 2)
@@ -346,6 +359,7 @@ def draw_spec(seed: int, profile: str = "soak") -> ScenarioSpec:
         rf=rf,
         durable=durable,
         wal_fsync=wal_fsync,
+        engine=engine,
         net_seed=seed,
         rtt_ms=rtt_ms,
         jitter_ms=jitter_ms,
@@ -425,6 +439,7 @@ def _scenario_env(spec: ScenarioSpec, flight_dir: Optional[str]):
         "MOCHI_SCENARIO_SEED": str(spec.seed),
         "MOCHI_SCENARIO_SPEC_HASH": spec.spec_hash(),
         "MOCHI_WAL_FSYNC": spec.wal_fsync if spec.durable else None,
+        "MOCHI_STORAGE_ENGINE": spec.engine if spec.durable else None,
     }
     if flight_dir:
         patch.update(
@@ -738,7 +753,7 @@ async def _drive_virtual(spec: ScenarioSpec, res: ScenarioResult, storage_dir: O
     )
     res.steps.append(
         f"topology: n={spec.n_servers} rf={spec.rf} f={spec.f} "
-        f"durable={spec.durable} backend=virtual"
+        f"durable={spec.durable} engine={spec.engine} backend=virtual"
     )
     res.steps.append(
         f"netsim: rtt={spec.rtt_ms}ms jitter={spec.jitter_ms}ms drop={spec.drop}"
@@ -753,6 +768,7 @@ async def _drive_virtual(spec: ScenarioSpec, res: ScenarioResult, storage_dir: O
             netsim=sim,
             byzantine=byz_map or None,
             storage_dir=storage_dir,
+            storage_engine=spec.engine if spec.durable else None,
         ) as vc:
             checker = InvariantChecker(vc.honest_replicas(), sorted(byz_map))
             clients = [
@@ -797,7 +813,7 @@ async def _drive_process(spec: ScenarioSpec, res: ScenarioResult) -> None:
     fault = spec.faults[0]
     res.steps.append(
         f"topology: n={spec.n_servers} rf={spec.rf} f={spec.f} "
-        f"durable=True backend=process"
+        f"durable=True engine={spec.engine} backend=process"
     )
     res.steps.append(f"L0: sigkill {json.dumps(fault, sort_keys=True)}")
     async with ProcessCluster(
@@ -806,6 +822,7 @@ async def _drive_process(spec: ScenarioSpec, res: ScenarioResult) -> None:
         n_processes=spec.n_servers,
         storage_dir=True,
         wal_fsync=spec.wal_fsync,
+        storage_engine=spec.engine,
     ) as pc:
         client = pc.client(
             timeout_s=spec.timeout_s,
@@ -1035,8 +1052,15 @@ def minimize(
             f"n_servers={new_n}",
         )
     # 4. strip the storage/conditioning riders
+    if current.engine != "wal":
+        # shrink the engine before durability: a paged-engine violation
+        # that also reproduces on the WAL engine isn't a paging bug
+        attempt(dataclasses.replace(current, engine="wal"), "engine=wal")
     if current.durable:
-        attempt(dataclasses.replace(current, durable=False), "durable=False")
+        attempt(
+            dataclasses.replace(current, durable=False, engine="wal"),
+            "durable=False",
+        )
     if current.rtt_ms > 0 or current.drop > 0:
         attempt(
             dataclasses.replace(

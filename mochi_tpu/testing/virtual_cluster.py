@@ -69,6 +69,9 @@ class VirtualCluster:
         # from disk instead of booting empty.  None (default): in-memory,
         # exactly the reference's posture.
         storage_dir: Optional[str] = None,
+        # Which durable engine a storage_dir gets: "wal" (default) or
+        # "paged" (round 17) — None defers to MOCHI_STORAGE_ENGINE.
+        storage_engine: Optional[str] = None,
     ):
         self.n_servers = n_servers
         self.rf = rf
@@ -81,6 +84,7 @@ class VirtualCluster:
         self.netsim = netsim
         self.byzantine: Dict[str, object] = dict(byzantine or {})
         self.storage_dir = storage_dir
+        self.storage_engine = storage_engine
         # Unix-domain sockets instead of loopback TCP (per-replica socket
         # files under this dir): skips the TCP/IP stack on the kernel send
         # path, the measured cost floor for single-host clusters
@@ -183,6 +187,7 @@ class VirtualCluster:
             port=port,
             netsim=self.netsim,
             storage_dir=self.storage_dir,
+            storage_engine=self.storage_engine,
             **kwargs,
         )
         strategy = self.byzantine.get(sid)

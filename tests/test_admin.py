@@ -205,6 +205,62 @@ def test_admin_storage_surfaces_durable():
         del os.environ["MOCHI_WAL_FSYNC"]
 
 
+def test_admin_storage_surfaces_paged():
+    """Round-17 satellite pin: a paged-engine replica's /status "storage"
+    key (pages/cache/compaction/memtable blocks), the flattened
+    ``mochi_storage{stat="pages.resident"}``-style prom leaves, and the
+    "/" page Storage table rendering the paged counters."""
+
+    async def body(td):
+        async with VirtualCluster(
+            4, rf=4, storage_dir=td, storage_engine="paged"
+        ) as vc:
+            client = vc.client()
+            for i in range(8):
+                await client.execute_write_transaction(
+                    TransactionBuilder().write(f"adm-pg-{i}", b"v").build()
+                )
+            replica = vc.replicas[0]
+            # a deterministic page flush so pages/cache counters are live
+            await replica.storage.flush()
+            await replica.storage.snapshot(replica.store)
+            admin = AdminServer(replica, port=0)
+            await admin.start()
+            try:
+                port = admin.bound_port
+                loop = asyncio.get_running_loop()
+                _, _, raw = await loop.run_in_executor(None, _get, port, "/status")
+                st = json.loads(raw)["storage"]
+                assert st["engine"] == "paged"
+                assert st["pages"]["count"] >= 1
+                assert st["pages"]["resident"] >= 1
+                assert st["pages"]["convicted"] == 0
+                assert st["cache"]["cap_bytes"] > 0
+                assert st["cache"]["resident_bytes"] >= 0
+                assert st["compaction"]["debt"] >= 0
+                assert st["memtable"]["cap_bytes"] > 0
+                _, _, prom = await loop.run_in_executor(
+                    None, _get, port, "/metrics.prom"
+                )
+                for stat in (
+                    "pages.count", "pages.resident", "pages.convicted",
+                    "cache.cap_bytes", "cache.hits", "cache.misses",
+                    "cache.evictions", "compaction.debt",
+                    "compaction.runs", "memtable.dirty_keys",
+                ):
+                    assert f'mochi_storage{{stat="{stat}"' in prom, stat
+                _, _, page = await loop.run_in_executor(None, _get, port, "/")
+                assert "Storage" in page and "pages.count" in page
+                assert "cache.cap_bytes" in page
+            finally:
+                await admin.close()
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        asyncio.run(asyncio.wait_for(body(td), timeout=120))
+
+
 def test_fanout_surfaces_and_client_admin_shell():
     asyncio.run(asyncio.wait_for(_fanout_main(), timeout=60))
 

@@ -1,5 +1,5 @@
 """Benchmark-harness smoke (tier-1): ``run_all --smoke`` must produce an
-error-free, provenance-stamped record from ALL 13 configs in seconds.
+error-free, provenance-stamped record from ALL 14 configs in seconds.
 
 This is rot detection, not measurement: a benchmark that imports a moved
 module, calls a renamed API, or drifts its record schema fails HERE, at
@@ -28,7 +28,7 @@ def _run(args, timeout):
     )
 
 
-def test_run_all_smoke_covers_all_thirteen_configs():
+def test_run_all_smoke_covers_all_fourteen_configs():
     proc = _run(["--smoke"], timeout=700)
     assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-800:]
     recs = [
@@ -37,9 +37,9 @@ def test_run_all_smoke_covers_all_thirteen_configs():
         if line.startswith("{")
     ]
     by_config = {r.get("config"): r for r in recs}
-    # configs 1-13: 13 (scenario-engine soak) joined in round 16
+    # configs 1-14: 14 (paged value engine) joined in round 17
     assert sorted(by_config, key=int) == [
-        str(i) for i in range(1, 14)
+        str(i) for i in range(1, 15)
     ], sorted(by_config)
     for key, rec in sorted(by_config.items()):
         assert not rec.get("error"), (key, rec)

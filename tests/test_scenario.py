@@ -181,6 +181,41 @@ def test_family_sigkill_process_cluster_recovers_acked():
     assert res.report["acked_writes"] == len(res.acked) > 0
 
 
+def test_family_sigkill_paged_engine_recovers_acked():
+    """The round-17 paged engine under the harshest family: SIGKILL a real
+    process mid-load, restart, recover from page index + WAL tail — every
+    acked write must read back (the engine dimension of generator v2)."""
+    spec = _spec(
+        208,
+        backend="process",
+        durable=True,
+        wal_fsync="group",
+        engine="paged",
+        keys_per_client=3,
+        timeout_s=8.0,
+        faults=[{"family": "sigkill", "victims": 1, "restart": True}],
+    )
+    res = run_scenario(spec)
+    assert res.ok, (res.error, res.violations)
+    assert any("sigkill server-0" in s for s in res.steps)
+    assert any("engine=paged" in s for s in res.steps), res.steps
+    assert res.report["acked_writes"] == len(res.acked) > 0
+
+
+def test_engine_dimension_drawn_and_gated_on_durable():
+    """Generator v2's engine stream: paged and wal both actually drawn,
+    never a paged engine without durability, and the dimension rides a
+    NEW stream (existing components' draws did not shift)."""
+    engines = set()
+    for seed in range(160):
+        sp = draw_spec(seed)
+        engines.add((sp.durable, sp.engine))
+        if not sp.durable:
+            assert sp.engine == "wal", seed
+    assert (True, "paged") in engines
+    assert (True, "wal") in engines
+
+
 # ------------------------------------------------------------- violation arc
 
 

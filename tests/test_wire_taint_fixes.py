@@ -307,6 +307,13 @@ LIVE_MUTATIONS = [
      "checked = await self._check_certificate(entry.certificate)",
      "checked = entry.certificate",
      "sync-adopt"),
+    # round 17: the paged engine's fault path — drop the per-entry recheck
+    # between read_page_entry (taint source) and apply_sync_entry (CERT
+    # sink) and the disk-tainted entry reaches adoption unsanctioned
+    ("mochi_tpu/storage/paged.py",
+     "if not self._page_entry_admissible(store, key, txn, cert, ent):",
+     "if txn is None and cert is None:",
+     "sync-adopt"),
 ]
 
 
