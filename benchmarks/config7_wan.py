@@ -74,14 +74,19 @@ def _pcts(samples: List[float]) -> Dict[str, float]:
     return {"p50": at(0.50), "p95": at(0.95), "p999": at(0.999)}
 
 
-async def _wan_run(n_clients: int, keys_per_client: int, sweeps: int) -> Dict:
+async def _wan_run(
+    n_clients: int,
+    keys_per_client: int,
+    sweeps: int,
+    fast_path: Optional[bool] = None,
+) -> Dict:
     from mochi_tpu.client.txn import TransactionBuilder
     from mochi_tpu.netsim import NetSim
     from mochi_tpu.testing.virtual_cluster import VirtualCluster
     from mochi_tpu.utils.runtime import reset_gc_debt
 
     sim = NetSim.mesh(seed=SEED, rtt_ms=RTT_MS, jitter_ms=JITTER_MS)
-    async with VirtualCluster(5, rf=4, netsim=sim) as vc:
+    async with VirtualCluster(5, rf=4, netsim=sim, fast_path=fast_path) as vc:
         read_lat: List[float] = []
         write_lat: List[float] = []
         clients = []
@@ -512,10 +517,112 @@ def run_trace_ab(pairs: int = 7) -> Dict:
     return rec
 
 
+# ---------------------------------------------- fast-path on/off A/B (r18)
+
+
+def run_fastpath_ab(
+    pairs: int = 3,
+    n_clients: int = 5,
+    keys_per_client: int = 40,
+    sweeps: int = 2,
+) -> Dict:
+    """Round-18 interleaved paired A/B: the full-shape config-7 WAN leg
+    with the session MAC fast path ON (MAC'd envelopes + signed
+    checkpoints + one-attestation certificates) vs OFF (per-message
+    Ed25519, grant-by-grant certificates — the pre-r18 wire).  Same
+    discipline as every committed A/B since r06: leg order alternating
+    pair to pair, the per-pair write-p50 RATIO as the statistic, plus the
+    commit-breakdown stage deltas the tentpole predicts (write1-phase and
+    write2-fanout-wait shed their verify CPU; the RTT share stays)."""
+    from mochi_tpu.net import transport
+    from mochi_tpu.utils.runtime import tune_gc_for_server
+
+    tune_gc_for_server()
+    prev_floor = transport.RTT_FLOOR_S
+    transport.RTT_FLOOR_S = max(prev_floor, RTT_MS / 1e3)
+
+    def _leg(fp: bool) -> Dict:
+        return asyncio.run(_wan_run(n_clients, keys_per_client, sweeps, fast_path=fp))
+
+    rows = []
+    try:
+        for i in range(pairs):
+            if i % 2 == 0:
+                on = _leg(True)
+                off = _leg(False)
+            else:
+                off = _leg(False)
+                on = _leg(True)
+            rows.append(
+                {
+                    "on_write_ms": on["write_ms"],
+                    "off_write_ms": off["write_ms"],
+                    "on_read_ms": on["read_ms"],
+                    "off_read_ms": off["read_ms"],
+                    "on_commit_breakdown_ms": on["commit_breakdown_ms"],
+                    "off_commit_breakdown_ms": off["commit_breakdown_ms"],
+                    "write_p50_ratio": round(
+                        on["write_ms"]["p50"] / off["write_ms"]["p50"], 4
+                    ),
+                    "write_p999_ratio": round(
+                        on["write_ms"]["p999"] / off["write_ms"]["p999"], 4
+                    ),
+                }
+            )
+    finally:
+        transport.RTT_FLOOR_S = prev_floor
+
+    med = lambda xs: statistics.median(sorted(xs))  # noqa: E731
+    on_p50 = med([r["on_write_ms"]["p50"] for r in rows])
+    off_p50 = med([r["off_write_ms"]["p50"] for r in rows])
+    stages = sorted(
+        set().union(*(r["on_commit_breakdown_ms"] for r in rows))
+        & set().union(*(r["off_commit_breakdown_ms"] for r in rows))
+    )
+    breakdown_delta = {
+        stage: round(
+            med([r["on_commit_breakdown_ms"][stage] for r in rows
+                 if stage in r["on_commit_breakdown_ms"]])
+            - med([r["off_commit_breakdown_ms"][stage] for r in rows
+                   if stage in r["off_commit_breakdown_ms"]]),
+            2,
+        )
+        for stage in stages
+    }
+    rec = {
+        "pairs": pairs,
+        "shape": {
+            "clients": n_clients, "keys_per_client": keys_per_client,
+            "sweeps": sweeps, "mesh_rtt_ms": RTT_MS,
+            "mesh_jitter_ms": JITTER_MS, "netsim_seed": SEED,
+        },
+        "per_pair": rows,
+        "on_write_p50_ms_median": round(on_p50, 2),
+        "off_write_p50_ms_median": round(off_p50, 2),
+        "median_write_p50_ratio_on_over_off": round(
+            med([r["write_p50_ratio"] for r in rows]), 4
+        ),
+        "median_write_p999_ratio_on_over_off": round(
+            med([r["write_p999_ratio"] for r in rows]), 4
+        ),
+        # the stage deltas (on minus off, ms at p50): where the fast path
+        # actually took its time from
+        "commit_breakdown_delta_ms_on_minus_off": breakdown_delta,
+        # acceptance: RTT-bound means ~2 RTT ≈ 27 ms; the bar is ≤ 33 ms
+        "acceptance_on_write_p50_le_33ms": on_p50 <= 33.0,
+    }
+    ci = _median_ci95(sorted(r["write_p50_ratio"] for r in rows))
+    if ci is not None:
+        rec["write_p50_ratio_ci95"] = [round(ci[0], 4), round(ci[1], 4)]
+    return rec
+
+
 # ----------------------------------------------- live verifies/txn meter
 
 
-def run_verify_meter(n: int = 64, writes: int = 4) -> Dict:
+def run_verify_meter(
+    n: int = 64, writes: int = 4, fast_path: Optional[bool] = None
+) -> Dict:
     """The live 43-checks/txn meter at the BASELINE shape: an n=64 rf=n
     cluster (f=21, quorum=43) with ONE shared caching verifier standing in
     for the config-6/8 verifier-service posture, writes serialized so the
@@ -541,7 +648,9 @@ def run_verify_meter(n: int = 64, writes: int = 4) -> Dict:
     shared = CachingVerifier(CpuVerifier())
 
     async def body() -> Dict:
-        async with VirtualCluster(n, rf=n, verifier_factory=lambda: shared) as vc:
+        async with VirtualCluster(
+            n, rf=n, verifier_factory=lambda: shared, fast_path=fast_path
+        ) as vc:
             client = vc.client()
             cards = []
             for i in range(writes):
@@ -572,9 +681,12 @@ def run_verify_meter(n: int = 64, writes: int = 4) -> Dict:
     uniq = [c["verify_unique"] for c in steady]
     memo = [c["verify_memoized"] for c in steady]
     items = [c["verify_items"] for c in steady]
+    from mochi_tpu.crypto.session import fast_path_enabled
+
     mean = lambda xs: sum(xs) / len(xs) if xs else float("nan")  # noqa: E731
     rec = {
         "cluster": {"n": n, "rf": n, "f": (n - 1) // 3, "quorum": out["quorum"]},
+        "fast_path": fast_path_enabled(fast_path),
         "writes": writes,
         "txns_metered": len(steady),
         "verify_items_per_txn_mean": round(mean(items), 2),

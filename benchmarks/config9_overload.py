@@ -452,6 +452,128 @@ def run(
         transport.RTT_FLOOR_S = prev_floor
 
 
+# ------------------------------------------- handshake-storm rung (r18)
+
+
+async def _handshake_storm_leg(
+    n_sessions: int, ramp_batch: int = 64, timeout_s: float = 5.0
+) -> Dict:
+    """One storm leg at this config's shape: ``n_sessions`` fresh SDK
+    clients dial the 5-replica signed cluster and each primes with one
+    read — exactly the connection + X25519/MAC-session ramp the open-loop
+    phases above run before their clock starts.  Here the ramp IS the
+    measurement: sessions established per second of ramp wall time."""
+    from mochi_tpu.client.txn import TransactionBuilder
+    from mochi_tpu.netsim import NetSim
+    from mochi_tpu.server.admission import TokenBucket
+    from mochi_tpu.testing.virtual_cluster import VirtualCluster
+
+    sim = NetSim.mesh(seed=SEED, rtt_ms=RTT_MS, jitter_ms=JITTER_MS)
+    async with VirtualCluster(5, rf=4, netsim=sim) as vc:
+        # the rung measures handshake CPU, not the admission knob: open
+        # the bucket so no leg spends its wall time in refusal/retry
+        for r in vc.replicas:
+            r._handshakes = TokenBucket(rate_per_s=1e6, burst=1e6)
+        clients = [vc.client(timeout_s=timeout_s) for _ in range(n_sessions)]
+        t0 = time.perf_counter()
+        for i in range(0, n_sessions, ramp_batch):
+            await asyncio.gather(
+                *(
+                    c.execute_read_transaction(
+                        TransactionBuilder().read(f"storm-{i}").build()
+                    )
+                    for c in clients[i : i + ramp_batch]
+                ),
+                return_exceptions=True,
+            )
+        wall = time.perf_counter() - t0
+        established = sum(
+            r.metrics.counters.get("replica.sessions-established", 0)
+            for r in vc.replicas
+        )
+    return {
+        "n_sessions": n_sessions,
+        "sessions_established": established,
+        "ramp_wall_s": round(wall, 3),
+        "handshakes_per_s": round(established / wall, 1) if wall else None,
+    }
+
+
+def run_handshake_storm(n_sessions: int = 256, pairs: int = 3) -> Dict:
+    """Round-18 rung: the handshake storm re-measured with the native-C
+    X25519 ladder vs the pure-Python ladder it replaced (the 2.5 ms/side
+    DH that dominated this ramp since PR 8).  Interleaved paired legs,
+    per-pair handshakes/s ratio; only the X25519 entry point is swapped —
+    Ed25519 keeps its native engine in BOTH legs, so the ratio isolates
+    the handshake's share."""
+    from mochi_tpu.crypto import hostfallback as hf
+    from mochi_tpu.utils.runtime import tune_gc_for_server
+
+    tune_gc_for_server()
+    native = hf._native_engine() is not None and hasattr(
+        hf._native_engine(), "x25519"
+    )
+
+    _routed = hf.x25519
+
+    def _pure_x25519(private: bytes, peer_public: bytes) -> bytes:
+        # per-call save/restore: the swap window never spans an await, so
+        # concurrent Ed25519 callers keep their native engine
+        saved = hf._native
+        hf._native = None
+        try:
+            return _routed(private, peer_public)
+        finally:
+            hf._native = saved
+
+    def _leg(pure: bool) -> Dict:
+        if pure:
+            hf.x25519 = _pure_x25519  # session layer resolves at call time
+        try:
+            return asyncio.run(_handshake_storm_leg(n_sessions))
+        finally:
+            hf.x25519 = _routed
+
+    rows = []
+    for i in range(pairs):
+        if i % 2 == 0:
+            nat = _leg(False)
+            pure = _leg(True)
+        else:
+            pure = _leg(True)
+            nat = _leg(False)
+        rows.append(
+            {
+                "native_handshakes_per_s": nat["handshakes_per_s"],
+                "pure_handshakes_per_s": pure["handshakes_per_s"],
+                "native_ramp_wall_s": nat["ramp_wall_s"],
+                "pure_ramp_wall_s": pure["ramp_wall_s"],
+                "sessions_established": nat["sessions_established"],
+                "speedup": round(
+                    nat["handshakes_per_s"] / pure["handshakes_per_s"], 2
+                )
+                if nat["handshakes_per_s"] and pure["handshakes_per_s"]
+                else None,
+            }
+        )
+    import statistics
+
+    speedups = [r["speedup"] for r in rows if r["speedup"]]
+    return {
+        "n_sessions": n_sessions,
+        "pairs": pairs,
+        "native_x25519_available": native,
+        "shape": {
+            "replicas": 5, "rf": 4, "mesh_rtt_ms": RTT_MS,
+            "mesh_jitter_ms": JITTER_MS, "netsim_seed": SEED,
+        },
+        "per_pair": rows,
+        "median_storm_speedup_native_over_pure": (
+            round(statistics.median(speedups), 2) if speedups else None
+        ),
+    }
+
+
 if __name__ == "__main__":
     import json
 

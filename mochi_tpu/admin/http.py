@@ -601,6 +601,9 @@ class AdminServer(HttpJsonServer):
                         for name, h in sorted(r.metrics.histograms.items())
                     },
                     "sessions": len(getattr(r, "_sessions", {})),
+                    # round-18 fast path: checkpoint ledgers, peer-session
+                    # windows, aggregate-verify effectiveness
+                    "fastpath": r.fastpath_stats(),
                     # admission control + bounded-state surface: shed
                     # probability, deterministic load components, session-
                     # table size/evictions (docs/OPERATIONS.md §4g)
@@ -845,6 +848,10 @@ class ClientAdminServer(HttpJsonServer):
                     "client_id": c.client_id,
                     "early_quorum": bool(c.early_quorum),
                     "sessions": len(c._sessions),
+                    # round-18 fast path: checkpoint windows + deferred-
+                    # grant/audit counters (the initiator-side half of the
+                    # replica /status "fastpath" object)
+                    "fastpath": c.fastpath_stats(),
                     "fanout": _fanout_stats(m),
                     # per-peer tally-path suspicion breakdown (the fanout
                     # peers table carries the same data as suspect_* rows)

@@ -252,7 +252,7 @@ def register_edge(edge: Edge) -> Edge:
 # to any scan that didn't happen to import the module first, and its
 # sanctioned sanitizer would read as an unregistered check (a false
 # finding) or, worse, its expect_live pin would silently not apply.
-_EDGE_PROVIDERS = ("mochi_tpu.storage.paged",)
+_EDGE_PROVIDERS = ("mochi_tpu.storage.paged", "mochi_tpu.server.replica")
 _providers_loaded = False
 
 

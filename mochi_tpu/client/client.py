@@ -49,6 +49,8 @@ from ..protocol import (
     ReadToServer,
     RequestFailedFromServer,
     SessionAckFromServer,
+    SessionCheckpointAckFromServer,
+    SessionCheckpointToServer,
     SessionInitToServer,
     Status,
     Transaction,
@@ -179,8 +181,16 @@ class MochiDBClient:
     # scenario; the honest-loopback loss stands, so the default stays
     # False — measure per deployment.
     trim_write1: bool = False
+    # Round-18 fast path (crypto/session.py): MAC'd envelopes get signed
+    # checkpoint declarations every CHECKPOINT_MSGS/CHECKPOINT_MS, and
+    # arriving MultiGrants from unsuspected MAC-session peers defer their
+    # Ed25519 check to the replicas' certificate verify (audited
+    # synchronously on any BAD_CERTIFICATE commit answer).  None = the
+    # MOCHI_FAST_PATH env knob; resolved to a bool in __post_init__.
+    fast_path: Optional[bool] = None
 
     def __post_init__(self) -> None:
+        self.fast_path = session_crypto.fast_path_enabled(self.fast_path)
         self.pool = RpcClientPool(
             default_timeout_s=self.timeout_s,
             netsim=self.netsim,
@@ -203,6 +213,11 @@ class MochiDBClient:
         # fallback (and the handshake carrier) — crypto/session.py.
         self._sessions: Dict[str, bytes] = {}
         self._session_locks: Dict[str, asyncio.Lock] = {}
+        # sid -> sender-side checkpoint window (fast path): digests of
+        # every MAC'd envelope sent, declared under an Ed25519 signature
+        # each window so the receiver can convict MAC-window tampering
+        # retroactively (crypto/session.SessionWindow).
+        self._windows: Dict[str, session_crypto.SessionWindow] = {}
         # sid -> monotonic deadline: servers that sent an AUTHENTICATED
         # BAD_SIGNATURE handshake refusal (secure posture, identity not in
         # that replica's registry).  Skip re-handshaking until the deadline
@@ -264,6 +279,27 @@ class MochiDBClient:
         while events and events[0] < cutoff:
             events.popleft()
         return len(events)
+
+    def fastpath_stats(self) -> Dict[str, object]:
+        """Round-18 fast-path posture from the initiator side: per-peer
+        checkpoint windows plus the deferred-grant and audit counters
+        (ClientAdminServer surface)."""
+        return {
+            "fast_path": self.fast_path,
+            "windows": {
+                sid: {"pending": len(w.pending), "window": w.window,
+                      "sent": w.sent}
+                for sid, w in self._windows.items()
+            },
+            "checkpoints": self.metrics.counters.get("client.checkpoints", 0),
+            "grant_verifies_deferred": self.metrics.counters.get(
+                "client.grant-verify-deferred", 0
+            ),
+            "cert_audits": self.metrics.counters.get("client.cert-audits", 0),
+            "cert_audit_convictions": self.metrics.counters.get(
+                "client.cert-audit-convictions", 0
+            ),
+        }
 
     def suspicion_stats(self) -> Dict[str, Dict[str, int]]:
         """Per-peer suspicion breakdown (ClientAdminServer surface)."""
@@ -372,7 +408,15 @@ class MochiDBClient:
             )
             session_key = self._sessions.get(sid) if sid is not None else None
             if session_key is not None and not self._needs_signature(payload):
-                return session_crypto.seal(env, session_key)
+                sealed = session_crypto.seal(env, session_key)
+                if self.fast_path:
+                    # Transcript for the next signed checkpoint: every
+                    # MAC'd envelope's canonical auth bytes get declared
+                    # under an Ed25519 signature within one window.
+                    self._windows.setdefault(
+                        sid, session_crypto.SessionWindow()
+                    ).note(sealed.signing_bytes())
+                return sealed
             return env.with_signature(self.keypair.sign(env.signing_bytes()))
 
     def _authentic(self, sid: str, env: Envelope) -> bool:
@@ -523,6 +567,57 @@ class MochiDBClient:
                 responder_id=sid,
                 initiated=True,
             )
+            # Fresh session, fresh transcript: the replica's checkpoint
+            # ledger reset on this handshake too (replica._session_init).
+            self._windows.pop(sid, None)
+
+    async def _checkpoint(self, sid: str, info: ServerInfo) -> None:
+        """Send one signed checkpoint declaration for ``sid``'s MAC window
+        (crypto/session.py design note).  Best-effort: a lost or refused
+        checkpoint keeps its digests pending for the next attempt (the
+        window's ``take`` never clears speculatively), and a typed refusal
+        tears the session down — the next fan-out re-handshakes with a
+        clean transcript on both sides."""
+        win = self._windows.get(sid)
+        if win is None or not win.pending:
+            return
+        window, digests = win.take()
+        ticket = win  # the handle the taken digests belong to
+        # sid=None: checkpoints are ALWAYS Ed25519-signed — a MAC'd
+        # declaration could be forged by whoever holds the session key,
+        # which is exactly the adversary the checkpoint convicts.
+        env = self._envelope(
+            SessionCheckpointToServer(window, digests), new_msg_id()
+        )
+        try:
+            res = await self.pool.send_and_receive(
+                info, env, min(self.timeout_s, 5.0)
+            )
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            self.metrics.mark(f"client.checkpoint-lost.{sid}")
+            return  # re-declared on the next due() window
+        ack = res.payload
+        # Re-read after the await: a concurrent teardown/re-handshake may
+        # have replaced the window, and the fresh one owns a NEW transcript
+        # — retiring these digests against it would corrupt it.
+        win = self._windows.get(sid)
+        if win is None or win is not ticket:
+            return
+        if isinstance(
+            ack, SessionCheckpointAckFromServer
+        ) and self._authentic(sid, res):
+            win.committed(len(digests))
+            self.metrics.mark("client.checkpoints")
+            return
+        # Refusal (overdue policy, carry overflow, or — convicted on the
+        # replica — a transcript mismatch): drop the session and window;
+        # traffic falls back to signed envelopes until the lazy
+        # re-handshake.
+        self.metrics.mark(f"client.checkpoint-refused.{sid}")
+        self._sessions.pop(sid, None)
+        self._windows.pop(sid, None)
 
     async def _fan_out(
         self,
@@ -555,6 +650,21 @@ class MochiDBClient:
             await asyncio.gather(
                 *(self._ensure_session(sid, info) for sid, info in missing)
             )
+        if self.fast_path:
+            # Due checkpoint windows flush BEFORE the fan-out (concurrent
+            # across peers, off the per-request path the rest of the time):
+            # past the receiver's overdue cap MAC'd requests get typed
+            # refusals, so the declaration must stay ahead of the traffic.
+            due = [
+                (sid, info)
+                for sid, info in targets
+                if (w := self._windows.get(sid)) is not None
+                and (w.due() or w.overdue_risk())
+            ]
+            if due:
+                await asyncio.gather(
+                    *(self._checkpoint(sid, info) for sid, info in due)
+                )
         quorum_done = None
         # sids the predicate already authenticated this fan-out — the
         # post-filter below skips re-verifying those (the second HMAC —
@@ -594,16 +704,27 @@ class MochiDBClient:
             payload = res.payload
             if (
                 isinstance(payload, RequestFailedFromServer)
-                and payload.fail_type == FailType.BAD_SIGNATURE
                 and sid in self._sessions
+                and (
+                    payload.fail_type == FailType.BAD_SIGNATURE
+                    or (
+                        payload.fail_type == FailType.BAD_REQUEST
+                        and "checkpoint" in payload.detail
+                    )
+                )
             ):
-                # Replica restarted and lost our session: our MAC bounced.
+                # Replica restarted and lost our session (MAC bounced) —
+                # or refused further MAC traffic pending a signed
+                # checkpoint it considers overdue (fast-path policy,
+                # e.g. a replica restarted mid-window or this client has
+                # checkpoints off): tear down and re-handshake fresh.
                 stale_sessions.append(sid)
                 continue
             out[sid] = payload
         if stale_sessions and _retry:
             for sid in stale_sessions:
                 self._sessions.pop(sid, None)
+                self._windows.pop(sid, None)
             # arrived=None on the stale-session retry: the caller's
             # tracker (QuorumTally/GrantAssembler) already holds votes
             # from THIS attempt's discarded responses, so reusing it
@@ -896,9 +1017,24 @@ class MochiDBClient:
         # re-open the wrong-hash certificate-poisoning liveness hole the
         # kill switch has no reason to buy back.
         if key is not None and self.verify_grant_sigs and self.authenticate_servers:
-            if mg.signature is None or not cpu_verify(
-                key, mg.signing_bytes(), mg.signature
+            if mg.signature is None:
+                ok = False
+            elif (
+                self.fast_path
+                and mg.server_id in self._sessions
+                and self._suspicion_score(mg.server_id) == 0
             ):
+                # Verify-behind-commit (round 18): the grant arrived over
+                # an authenticated MAC session from an UNSUSPECTED peer;
+                # its Ed25519 check is deferred — every replica's own
+                # certificate verify (the quorum-critical check) still
+                # runs, and a BAD_CERTIFICATE commit answer triggers the
+                # synchronous per-grant audit (_audit_certificate) that
+                # attributes the poison and re-arms full verification via
+                # the suspicion score.  A suspected or session-less peer
+                # pays the signature check up front as before.
+                self.metrics.mark("client.grant-verify-deferred")
+            elif not cpu_verify(key, mg.signing_bytes(), mg.signature):
                 ok = False
         if ok:
             # Content: OK grants must commit to THIS transaction's hash.
@@ -915,6 +1051,51 @@ class MochiDBClient:
             self._suspect(mg.server_id, "bad-grant")
         mg.__dict__["_grant_ok"] = ok  # frozen dataclass: cache via __dict__
         return ok
+
+    def _audit_certificate(
+        self, certificate: WriteCertificate, txn_hash: bytes
+    ) -> List[str]:
+        """Synchronous audit of a certificate the replicas rejected
+        (fast-path suspicion trigger): re-run the FULL Ed25519 + content
+        check on every grant — including any whose check was deferred
+        behind the MAC session — and attribute each failure to its signer
+        with a suspicion mark and a flight-recorder dump.  Returns the
+        convicted server ids; the retry loop then rebuilds from fresh
+        grants, which the suspicion score forces through up-front
+        verification."""
+        bad: List[str] = []
+        for mg in certificate.grants.values():
+            key = self.config.public_keys.get(mg.server_id)
+            sig_ok = key is None or (
+                mg.signature is not None
+                and cpu_verify(key, mg.signing_bytes(), mg.signature)
+            )
+            content_ok = all(
+                g.transaction_hash == txn_hash
+                for g in mg.grants.values()
+                if g.status == Status.OK
+            )
+            if sig_ok and content_ok:
+                continue
+            bad.append(mg.server_id)
+            mg.__dict__["_grant_ok"] = False
+            self._suspect(mg.server_id, "bad-grant")
+            ctx = obs_trace.current_ctx()
+            attach = {
+                "kind": "audit-bad-grant",
+                "peer": mg.server_id,
+                "signature_ok": sig_ok,
+                "content_ok": content_ok,
+            }
+            self.tracer.force_mark("client.audit", ctx, args=attach)
+            try:
+                self.tracer.dump_flight("audit-bad-grant", attach)
+            except OSError:
+                LOG.exception("flight-recorder dump failed for audit")
+        self.metrics.mark("client.cert-audits")
+        if bad:
+            self.metrics.mark("client.cert-audit-convictions", len(bad))
+        return bad
 
     @staticmethod
     def _write1_transaction(transaction: Transaction) -> Transaction:
@@ -1220,6 +1401,13 @@ class MochiDBClient:
                     # a replay race) — fresh grants can fix that, so burn a
                     # refusal-retry instead of surfacing a dead end.  Any
                     # other split is real and raises.
+                    if exc.bad_certificate and self.fast_path:
+                        # Audit-on-suspicion (round 18): a deferred grant
+                        # check may have let the poison through — re-verify
+                        # every grant NOW, attribute the signer, and let
+                        # the suspicion score force the retry's grants
+                        # through up-front verification.
+                        self._audit_certificate(certificate, txn_hash)
                     if not await self.refresh_config() and not exc.bad_certificate:
                         raise
                     refusals += 1

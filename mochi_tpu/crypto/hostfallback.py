@@ -441,6 +441,17 @@ def x25519(private_bytes: bytes, peer_public: bytes) -> bytes:
         raise ValueError("An X25519 private key is 32 bytes long")
     if len(peer_public) != 32:
         raise ValueError("An X25519 public key is 32 bytes long")
+    mod = _native_engine()
+    # hasattr-gated per call, not folded into _native_engine's own gate: a
+    # stale prebuilt _hbatch.so with Ed25519 but no x25519 must keep the
+    # Ed25519 fast path while THIS function falls back to Python.
+    if mod is not None and hasattr(mod, "x25519"):
+        shared = mod.x25519(bytes(private_bytes), bytes(peer_public))
+        if not any(shared):
+            raise ValueError(
+                "X25519 shared secret is all zeros (small-order point)"
+            )
+        return shared
     k = int.from_bytes(bytes(private_bytes), "little")
     k &= (1 << 254) - 8
     k |= 1 << 254

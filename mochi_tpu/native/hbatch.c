@@ -459,6 +459,86 @@ static void fe_pow(fe *r, const fe *a, const uint8_t e[32]) {
     *r = acc;
 }
 
+/* --------------------------------------------------------------- X25519 */
+
+/* RFC 7748 §5 scalar multiplication on the Montgomery form — the session
+ * handshake's DH (crypto/session.py).  Pure-Python X25519 costs ~1.4 ms
+ * per operation on this host, which puts a cold fan-out's worth of
+ * handshakes at several blocked-loop milliseconds; this ladder reuses the
+ * 5x51 field ops above and lands in the tens of microseconds.  The
+ * private scalar IS secret (unlike the fe_pow exponents): the ladder is
+ * fixed-length with masked constant-time conditional swaps. */
+
+static void fe_cswap(fe *a, fe *b, uint64_t swap) {
+    uint64_t mask = 0 - swap; /* swap in {0,1} */
+    for (int i = 0; i < 5; i++) {
+        uint64_t t = mask & (a->v[i] ^ b->v[i]);
+        a->v[i] ^= t;
+        b->v[i] ^= t;
+    }
+}
+
+/* p - 2 = 2^255 - 21, little-endian bytes (fe_pow inversion exponent —
+ * public constant, so fe_pow's variable time is fine here too; shared
+ * with the ge section's inverse below). */
+static const uint8_t EXP_PM2[32] = {
+    0xeb, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff,
+    0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff,
+    0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff,
+    0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0x7f,
+};
+
+/* (A - 2) / 4 = 121665 as a field element (z2 step of the ladder) */
+static const fe FE_A24 = {{121665, 0, 0, 0, 0}};
+
+static void x25519_scalarmult(uint8_t out[32], const uint8_t scalar[32],
+                              const uint8_t point[32]) {
+    uint8_t k[32];
+    memcpy(k, scalar, 32);
+    k[0] &= 248; /* RFC 7748 clamp, in C: callers pass the raw seed */
+    k[31] &= 127;
+    k[31] |= 64;
+    /* fe_frombytes masks bit 255 of the peer point by construction */
+    fe x1, x2, z2, x3, z3;
+    fe a, aa, b, bb, e, c, d, da, cb, t;
+    fe_frombytes(&x1, point);
+    x2 = FE_ONE;
+    memset(&z2, 0, sizeof z2);
+    x3 = x1;
+    z3 = FE_ONE;
+    uint64_t swap = 0;
+    for (int bit = 254; bit >= 0; bit--) {
+        uint64_t k_t = (k[bit >> 3] >> (bit & 7)) & 1;
+        swap ^= k_t;
+        fe_cswap(&x2, &x3, swap);
+        fe_cswap(&z2, &z3, swap);
+        swap = k_t;
+        fe_add(&a, &x2, &z2);
+        fe_sq(&aa, &a);
+        fe_sub(&b, &x2, &z2);
+        fe_sq(&bb, &b);
+        fe_sub(&e, &aa, &bb);
+        fe_add(&c, &x3, &z3);
+        fe_sub(&d, &x3, &z3);
+        fe_mul(&da, &d, &a);
+        fe_mul(&cb, &c, &b);
+        fe_add(&t, &da, &cb);
+        fe_sq(&x3, &t);
+        fe_sub(&t, &da, &cb);
+        fe_sq(&t, &t);
+        fe_mul(&z3, &t, &x1);
+        fe_mul(&x2, &aa, &bb);
+        fe_mul(&t, &e, &FE_A24);
+        fe_add(&t, &aa, &t);
+        fe_mul(&z2, &e, &t);
+    }
+    fe_cswap(&x2, &x3, swap);
+    fe_cswap(&z2, &z3, swap);
+    fe_pow(&t, &z2, EXP_PM2);
+    fe_mul(&x2, &x2, &t);
+    fe_tobytes(out, &x2);
+}
+
 /* ---------------------------------------------------- group operations */
 
 /* Extended twisted-Edwards coordinates (X, Y, Z, T), x = X/Z, y = Y/Z,
@@ -538,14 +618,6 @@ static int ge_decompress(ge *out, const uint8_t s[32]) {
 }
 
 /* --------------------------------------------------- Ed25519 verify */
-
-/* p - 2, little-endian bytes (fe inversion exponent) */
-static const uint8_t EXP_PM2[32] = {
-    0xeb, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff,
-    0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff,
-    0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff,
-    0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0x7f,
-};
 
 /* Btab[d] = [d]B for d in 1..15 (index 0 unused; verify's Straus ladder)
  * and BCOMB[w][d] = [d * 16^w]B (hostfallback._window_table on limbs;
@@ -861,6 +933,30 @@ static PyObject *py_reduce512(PyObject *self, PyObject *args) {
     return PyBytes_FromStringAndSize((const char *)out, 32);
 }
 
+/* x25519(private, peer_public) -> 32-byte shared secret.  Clamping runs
+ * in C (RFC 7748 §5); the all-zero-output small-order rejection stays in
+ * the Python wrapper (crypto/hostfallback.x25519) so both engines share
+ * ONE policy seam. */
+static PyObject *py_x25519(PyObject *self, PyObject *args) {
+    Py_buffer kbuf, ubuf;
+    if (!PyArg_ParseTuple(args, "y*y*", &kbuf, &ubuf)) return NULL;
+    PyObject *result = NULL;
+    if (kbuf.len != 32 || ubuf.len != 32) {
+        PyErr_SetString(PyExc_ValueError, "x25519: keys must be 32 bytes");
+        goto done;
+    }
+    uint8_t out[32];
+    Py_BEGIN_ALLOW_THREADS
+    x25519_scalarmult(out, (const uint8_t *)kbuf.buf,
+                      (const uint8_t *)ubuf.buf);
+    Py_END_ALLOW_THREADS
+    result = PyBytes_FromStringAndSize((const char *)out, 32);
+done:
+    PyBuffer_Release(&kbuf);
+    PyBuffer_Release(&ubuf);
+    return result;
+}
+
 static PyMethodDef methods[] = {
     {"h_batch", py_h_batch, METH_VARARGS,
      "h_batch(r, a, msgs, lens) -> concatenated 32-byte h scalars"},
@@ -868,6 +964,8 @@ static PyMethodDef methods[] = {
      "verify_batch(pubs, sigs, hs) -> one verdict byte (0/1) per item"},
     {"sign_prepared", py_sign_prepared, METH_VARARGS,
      "sign_prepared(a, prefix, pub, msg) -> 64-byte Ed25519 signature"},
+    {"x25519", py_x25519, METH_VARARGS,
+     "x25519(private, peer_public) -> 32-byte shared secret (RFC 7748)"},
     {"sha512", py_sha512, METH_VARARGS, "test hook: one-shot SHA-512"},
     {"reduce512", py_reduce512, METH_VARARGS,
      "test hook: 64-byte LE value mod L as 32 LE bytes"},

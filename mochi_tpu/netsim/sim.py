@@ -47,6 +47,15 @@ from ..utils.metrics import Metrics
 
 __all__ = ["LinkSpec", "LinkEvent", "LinkPolicy", "NetSim"]
 
+# The event loop's systematic timer overshoot, compensated at arming time
+# (LinkPolicy.send): CPython's epoll selector rounds its poll timeout UP
+# to whole milliseconds (selectors.EpollSelector), then the loop
+# dispatches — a call_later fires ~0.5-0.8 ms LATE, so every simulated
+# hop silently inflates by most of a timer quantum.  Half a quantum is
+# the expected ceiling error; the residual dispatch cost stays, keeping
+# compensated arrivals slightly late (never early) on average.
+_TIMER_SLACK_S = 5e-4
+
 
 @dataclass(frozen=True)
 class LinkSpec:
@@ -199,7 +208,18 @@ class LinkPolicy:
             return
         counters[self._k_delayed] += 1
         handle_box: List = []
-        handle = loop.call_later(delay, self._arrive, handle_box, deliver, frame)
+        # Arm the timer EARLY by the loop's systematic overshoot: the
+        # epoll-backed selector rounds its poll timeout UP to whole
+        # milliseconds and the loop then dispatches, so call_later fires
+        # ~0.5-0.8 ms late (measured: a 6.5 ms one-way link delivers at
+        # ~7.3 ms, turning a claimed 13 ms RTT into 14.5 on the wire).  A
+        # simulator standing in for a real WAN must not inflate every hop
+        # by the host's timer quantum; the DRAWN delay (the determinism
+        # surface) is unchanged — only the arming compensates.
+        handle = loop.call_later(
+            max(0.0, delay - _TIMER_SLACK_S), self._arrive, handle_box,
+            deliver, frame,
+        )
         handle_box.append(handle)
         self._pending.add(handle)
         self.sim.metrics.set_gauge(self._k_depth, len(self._pending))

@@ -727,6 +727,49 @@ class SessionAckFromServer:
 
 
 # --------------------------------------------------------------------------
+# Session checkpoints (round 18, ``crypto/session.py``): the fast path's
+# retroactive identity binding.  Every CHECKPOINT_MSGS MAC'd envelopes (or
+# CHECKPOINT_MS) the sender Ed25519-signs the digest list of everything it
+# sealed in the window; the receiver's CheckpointLedger demands its accepted
+# multiset be covered — a MAC forgery or replay is convicted with the signed
+# declaration as transferable evidence.  Checkpoint envelopes themselves are
+# ALWAYS signed: a MAC'd checkpoint is by definition a downgrade attempt.
+
+
+@dataclass(frozen=True)
+class SessionCheckpointToServer:
+    """Signed declaration: digests of every MAC'd envelope the sender
+    sealed on this session since its last verified checkpoint."""
+
+    window: int
+    digests: Tuple[bytes, ...]
+
+    def to_obj(self) -> Any:
+        return [self.window, list(self.digests)]
+
+    @classmethod
+    def from_obj(cls, obj: Any) -> "SessionCheckpointToServer":
+        return cls(int(obj[0]), tuple(bytes(d) for d in obj[1]))
+
+
+@dataclass(frozen=True)
+class SessionCheckpointAckFromServer:
+    """Receiver verdict on a checkpoint window (signed, answered in-kind).
+    ``ok=False`` never rides this payload — mismatches are refused typed
+    (BAD_CERTIFICATE) so the sender's failure handling is uniform."""
+
+    window: int
+    accepted: int  # messages the receiver had accepted in this window
+
+    def to_obj(self) -> Any:
+        return [self.window, self.accepted]
+
+    @classmethod
+    def from_obj(cls, obj: Any) -> "SessionCheckpointAckFromServer":
+        return cls(int(obj[0]), int(obj[1]))
+
+
+# --------------------------------------------------------------------------
 # Envelope
 
 _PAYLOAD_TYPES: Tuple[Type, ...] = (
@@ -750,6 +793,8 @@ _PAYLOAD_TYPES: Tuple[Type, ...] = (
     SessionAckFromServer,
     SyncDigestRequestToServer,  # appended: existing wire tags stay stable
     SyncDigestFromServer,
+    SessionCheckpointToServer,  # appended: existing wire tags stay stable
+    SessionCheckpointAckFromServer,
 )
 _TAG_BY_TYPE = {cls: i for i, cls in enumerate(_PAYLOAD_TYPES)}
 

@@ -47,6 +47,8 @@ from ..protocol import (
     ReadToServer,
     RequestFailedFromServer,
     SessionAckFromServer,
+    SessionCheckpointAckFromServer,
+    SessionCheckpointToServer,
     SessionInitToServer,
     Status,
     SyncAckFromServer,
@@ -61,7 +63,12 @@ from ..protocol import (
     WriteCertificate,
 )
 from ..utils.metrics import Metrics
-from ..verifier.spi import CpuVerifier, SignatureVerifier, VerifyItem
+from ..verifier.spi import (
+    CpuVerifier,
+    SignatureVerifier,
+    VerifyItem,
+    aggregate_key,
+)
 from .admission import AdmissionController, SessionTable, TokenBucket
 from .store import BadRequest, DataStore, QuotaExceeded
 
@@ -106,6 +113,12 @@ CONVICTION_DUMPS_MAX = 8
 # at worst amnesty the OLDEST ban, never grow replica memory.
 CLIENT_BANS_MAX = 4096
 
+# Checkpoint-ledger bound (round 18, crypto/session.CheckpointLedger): one
+# receiver-side audit ledger per MAC session.  FIFO-bounded like the ban
+# book; evicting a ledger only forfeits THIS replica's retroactive audit of
+# that sender's current window (the session itself stays authenticated).
+CKPT_LEDGERS_MAX = 4096
+
 
 class MochiReplica:
     """One BFT replica node (ref: ``MochiServer.java`` + handler set)."""
@@ -135,6 +148,15 @@ class MochiReplica:
         storage=None,
         storage_dir: Optional[str] = None,
         storage_engine: Optional[str] = None,
+        # Round-18 fast-path posture (crypto/session.py): None -> the
+        # MOCHI_FAST_PATH env knob (default ON).  ON: MAC'd write
+        # certificates verify as ONE memoized aggregate attestation,
+        # replica->replica traffic rides MAC sessions, and checkpoint
+        # ledgers audit every MAC window.  OFF: the pre-round-18 posture
+        # (per-grant certificate checks, signed peer traffic) — the A/B
+        # and rollback leg.  Liveness/latency-only either way: downgrade
+        # attempts fail typed and convicted, never silently.
+        fast_path: Optional[bool] = None,
     ):
         self.server_id = server_id
         self.config = config
@@ -203,6 +225,21 @@ class MochiReplica:
         # scale thousands of client sessions must cost bounded memory, and
         # an evicted client transparently re-handshakes.
         self._sessions = SessionTable()
+        self.fast_path = session_crypto.fast_path_enabled(fast_path)
+        # Receiver-side checkpoint audit ledgers, one per MAC session
+        # (crypto/session.CheckpointLedger): the digest multiset of every
+        # accepted MAC'd envelope, reconciled against the sender's periodic
+        # SIGNED declaration — a MAC forgery or replay is convicted
+        # retroactively with transferable evidence.
+        self._ckpt_ledgers: Dict[str, session_crypto.CheckpointLedger] = {}
+        # Initiator-side peer MAC sessions (replica->replica resync/digest
+        # traffic): key + sender-side checkpoint window per peer, plus a
+        # failure TTL so a refusing/overloaded peer keeps getting signed
+        # envelopes instead of a handshake storm.
+        self._peer_sessions: Dict[str, bytes] = {}
+        self._peer_windows: Dict[str, session_crypto.SessionWindow] = {}
+        self._peer_hs_retry_at: Dict[str, float] = {}
+        self._peer_hs_locks: Dict[str, asyncio.Lock] = {}
         # Policy-evicted identities (evict_client): a banned sender's
         # re-handshake is refused, so "evicted" cannot silently mean
         # "re-admitted one round trip later".  Ordered dict as FIFO set;
@@ -243,7 +280,7 @@ class MochiReplica:
         self.store.on_config_value = self._install_config
         # Registry rotation/revocation invalidates the client's live MAC
         # session — the next envelope re-authenticates against the new key.
-        self.store.on_client_key_change = lambda cid: self._sessions.pop(cid, None)
+        self.store.on_client_key_change = lambda cid: self._drop_session(cid)
 
     # ----------------------------------------------------------------- boot
 
@@ -536,7 +573,50 @@ class MochiReplica:
         if session_key is None:
             return False
         with self.metrics.timer("replica.crypto-local"):
-            return session_crypto.mac_ok(session_key, env.signing_bytes(), env.mac)
+            ok = session_crypto.mac_ok(session_key, env.signing_bytes(), env.mac)
+        if not ok:
+            # A bad MAC on an ESTABLISHED session is tamper-or-spoof
+            # evidence (an honest client without the session key sends
+            # signed envelopes; the only benign cause is a re-handshake
+            # race on a stale key): record the conviction mark alongside
+            # the typed BAD_SIGNATURE the caller answers.  force_mark is a
+            # ring append and the flight dump is bounded per kind, so a
+            # tamper flood buys counters, not attacker-priced disk.
+            self.metrics.mark("replica.mac-tamper")
+            self._convict("mac-tamper", env, {"payload": type(env.payload).__name__})
+        return ok
+
+    def _drop_session(self, sender_id: str) -> None:
+        """Forget a MAC session AND its checkpoint ledger together — a
+        fresh handshake must always start with a fresh audit window."""
+        self._sessions.pop(sender_id, None)
+        self._ckpt_ledgers.pop(sender_id, None)
+
+    def _note_mac_accepted(self, env: Envelope) -> bool:
+        """Record one accepted MAC'd envelope in the sender's checkpoint
+        ledger (round 18).  False = the sender is past the overdue cap —
+        it has ridden the MAC discount for OVERDUE_FACTOR windows without
+        ever signing for them — so the session is dropped and the caller
+        answers a typed refusal (BAD_REQUEST, not BAD_SIGNATURE: policy,
+        not forgery; the client re-handshakes and re-sends)."""
+        if not self.fast_path:
+            return True
+        led = self._ckpt_ledgers.get(env.sender_id)
+        if led is None:
+            if len(self._ckpt_ledgers) >= CKPT_LEDGERS_MAX:
+                self._ckpt_ledgers.pop(next(iter(self._ckpt_ledgers)))
+            led = session_crypto.CheckpointLedger()
+            self._ckpt_ledgers[env.sender_id] = led
+        if led.note(env.signing_bytes()):
+            return True
+        self.metrics.mark("replica.checkpoint-overdue")
+        self._drop_session(env.sender_id)
+        return False
+
+    _OVERDUE_DETAIL = (
+        "session checkpoint overdue: too many MAC'd envelopes without a "
+        "signed transcript declaration; re-establish the session"
+    )
 
     @staticmethod
     def _is_admin_op(payload) -> bool:
@@ -628,6 +708,13 @@ class MochiReplica:
                         env,
                         RequestFailedFromServer(
                             FailType.BAD_SIGNATURE, "envelope signature invalid"
+                        ),
+                    )
+                elif not self._note_mac_accepted(env):
+                    out[i] = self._respond(
+                        env,
+                        RequestFailedFromServer(
+                            FailType.BAD_REQUEST, self._OVERDUE_DETAIL
                         ),
                     )
                 elif isinstance(payload, Write1ToServer):
@@ -745,7 +832,7 @@ class MochiReplica:
         # Stage 1 (sync): envelope-auth triage.  MACs check inline; signed
         # envelopes contribute one VerifyItem each.  A valid admin
         # signature IS authentication (and stronger).
-        AUTH_OK, AUTH_FAIL, AUTH_PENDING = 0, 1, 2
+        AUTH_OK, AUTH_FAIL, AUTH_PENDING, AUTH_OVERDUE = 0, 1, 2, 3
         auth = [AUTH_OK] * n
         admin_ok = [False] * n
         auth_pos = [-1] * n
@@ -768,6 +855,8 @@ class MochiReplica:
                 if env.mac is not None:
                     if not self._auth_mac(env):
                         auth[i] = AUTH_FAIL
+                    elif not self._note_mac_accepted(env):
+                        auth[i] = AUTH_OVERDUE
                     continue
                 key = self._sender_key(env.sender_id)
                 if key is None:
@@ -802,6 +891,11 @@ class MochiReplica:
         # signed bursts at worst pay one extra round trip.
         cert_prep: Dict[int, tuple] = {}
         deferred_cert: List[int] = []
+        # Round-18 one-attestation path: MAC-authenticated Write2s whose
+        # certificate can verify as a single memoized aggregate (index ->
+        # (agg_key, items, server_ids)).  Resolved in stage 4c; a failed
+        # aggregate falls back to the per-item attribution path.
+        agg_w2: Dict[int, tuple] = {}
         optimistic_budget = OPTIMISTIC_CERT_ITEM_BUDGET
         # Admin-gate verdicts snapshotted BEFORE the await: self.config is
         # mutable (a reconfiguration can land mid-await), and dispatch must
@@ -810,7 +904,7 @@ class MochiReplica:
         # (never-prepared) certificate path.
         w2_admin_denied: set = set()
         for i, env in enumerate(envs):
-            if auth[i] == AUTH_FAIL or dead[i]:
+            if auth[i] in (AUTH_FAIL, AUTH_OVERDUE) or dead[i]:
                 continue
             payload = env.payload
             if isinstance(payload, Write2ToServer):
@@ -824,6 +918,17 @@ class MochiReplica:
                     # the old path denied before the cert check too.
                     w2_admin_denied.add(i)
                     continue
+                if self.fast_path and env.mac is not None and auth[i] == AUTH_OK:
+                    # MAC-authenticated sender, fast path ON: the whole
+                    # 2f+1 grant set rides ONE verify_aggregate call,
+                    # memoized cluster-wide by cert hash — the meter-moving
+                    # change of round 18.  Ineligible certificates
+                    # (unresolvable signer, missing signature) need
+                    # attribution anyway and stay on the per-item path.
+                    agg = self._aggregate_items(payload.write_certificate)
+                    if agg is not None:
+                        agg_w2[i] = agg
+                        continue
                 if auth[i] == AUTH_PENDING and optimistic_budget <= 0:
                     deferred_cert.append(i)
                     continue
@@ -842,6 +947,17 @@ class MochiReplica:
                 items.extend(prep[2])
                 if auth[i] == AUTH_PENDING:
                     optimistic_budget -= len(prep[2])
+
+        # Stage 2b: launch the aggregate attestations as tasks so they
+        # overlap stage 3's pooled round trip (on a memoized verifier the
+        # common case resolves without any real crypto at all).
+        agg_tasks: Dict[int, asyncio.Task] = {}
+        if agg_w2:
+            loop = asyncio.get_running_loop()
+            for i, (akey, aitems, _sids) in agg_w2.items():
+                agg_tasks[i] = loop.create_task(
+                    self._verify_aggregate_counted(akey, aitems)
+                )
 
         # Stage 3: the single verifier round trip for the whole batch.
         if items:
@@ -881,6 +997,17 @@ class MochiReplica:
                     env,
                     RequestFailedFromServer(
                         FailType.BAD_SIGNATURE, "envelope signature invalid"
+                    ),
+                )
+            elif auth[i] == AUTH_OVERDUE:
+                # Authentic MAC, but the sender dodged its signed
+                # checkpoint for OVERDUE_FACTOR windows: typed policy
+                # refusal (session already dropped; the client
+                # re-handshakes and re-sends).
+                out[i] = self._respond(
+                    env,
+                    RequestFailedFromServer(
+                        FailType.BAD_REQUEST, self._OVERDUE_DETAIL
                     ),
                 )
 
@@ -929,6 +1056,63 @@ class MochiReplica:
             else:
                 prep, start = entry
                 cert_prep[i] = (prep, bitmap[start : start + len(prep[2])])
+
+        # Stage 4c: resolve the aggregate attestations.  A verified
+        # aggregate synthesizes an all-valid prep (dispatch then reuses the
+        # normal _finish_certificate path, including the equivocation
+        # ledger); a failed one pays the AUDIT — a per-item round trip with
+        # full attribution and the usual conviction machinery — so only
+        # Byzantine-polluted certificates ever ride the slow path, and
+        # never silently.
+        if agg_tasks:
+            audit_items: List[VerifyItem] = []
+            audit_prep: Dict[int, tuple] = {}
+            with metrics.timer("replica.auth-verify"):
+                if traced:
+                    tv0 = time.perf_counter()
+                    u0, m0 = self._verify_memo_counters()
+                for i, task in agg_tasks.items():
+                    try:
+                        ok = await task
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception:
+                        LOG.exception("aggregate verify failed for %s", envs[i].msg_id)
+                        ok = False
+                    _akey, _aitems, sids = agg_w2[i]
+                    if ok:
+                        metrics.mark("replica.cert-agg-verified")
+                        cert_prep[i] = ((sids, [True] * len(sids), [], []), [])
+                    else:
+                        metrics.mark("replica.cert-agg-audit")
+                        try:
+                            prep = self._prepare_certificate(
+                                envs[i].payload.write_certificate
+                            )
+                        except Exception:
+                            LOG.exception(
+                                "certificate prep failed for %s", envs[i].msg_id
+                            )
+                            dead[i] = True
+                            continue
+                        audit_prep[i] = (prep, len(audit_items))
+                        audit_items.extend(prep[2])
+                if audit_items:
+                    metrics.histogram("replica.verify-occupancy").observe(
+                        len(audit_items)
+                    )
+                    bitmap3 = await self._verify_counted(audit_items)
+                else:
+                    bitmap3 = []
+                if traced:
+                    charged = len(agg_tasks) + len(audit_items)
+                    verify_dur_s += time.perf_counter() - tv0
+                    verify_total_items += charged
+                    uniq, memo = self._verify_memo_delta(u0, m0, charged)
+                    verify_unique += uniq
+                    verify_memoized += memo
+            for i, (prep, start) in audit_prep.items():
+                cert_prep[i] = (prep, bitmap3[start : start + len(prep[2])])
 
         # Stage 5 (sync): typed dispatch; write1/write2 group into the
         # store's batch entry points.
@@ -1015,7 +1199,7 @@ class MochiReplica:
                 verify_dur_s, verify_total_items, verify_unique,
                 verify_memoized,
                 w2_apply_wall, w2_apply_dur, len(w2_reqs),
-                wal_wall, wal_dur,
+                wal_wall, wal_dur, set(agg_tasks),
             )
         return out
 
@@ -1024,7 +1208,7 @@ class MochiReplica:
         t_wall0, t_perf0,
         verify_dur_s, verify_total_items, verify_unique, verify_memoized,
         w2_apply_wall, w2_apply_dur, n_w2,
-        wal_wall, wal_dur,
+        wal_wall, wal_dur, agg_idx=frozenset(),
     ) -> None:
         """Slice this drain batch's SHARED costs back to its traced member
         transactions: the pooled ``verify_batch`` round trip is charged per
@@ -1036,6 +1220,12 @@ class MochiReplica:
         dur = time.perf_counter() - t_perf0
         for i, env in traced:
             k = (1 if auth_pos[i] >= 0 else 0)
+            if i in agg_idx:
+                # One-attestation path: the whole grant set was ONE
+                # aggregate call — the meter's honest unit for round 18
+                # (the unique/memoized split still prorates from the
+                # caching layer's real counters).
+                k += 1
             prep_entry = cert_prep.get(i)
             if prep_entry is not None:
                 k += len(prep_entry[0][2])
@@ -1123,6 +1313,47 @@ class MochiReplica:
         finally:
             self._admission.verify_inflight -= len(items)
 
+    async def _verify_aggregate_counted(
+        self, key: bytes, items: "List[VerifyItem]"
+    ) -> bool:
+        """verify_aggregate with the same admission occupancy accounting as
+        :meth:`_verify_counted` — a memo hit releases immediately, a miss
+        holds the slots for the one real batched round trip."""
+        self._admission.verify_inflight += len(items)
+        try:
+            return await self.verifier.verify_aggregate(key, items)
+        finally:
+            self._admission.verify_inflight -= len(items)
+
+    def _aggregate_items(self, wc: WriteCertificate) -> Optional[tuple]:
+        """Build the deterministic (agg_key, items, server_ids) triple for a
+        certificate's one-attestation verify, or None when the certificate
+        needs per-item handling anyway (unresolvable signer id, missing
+        signature, id mismatch — those drop grants with attribution).
+
+        The item list is byte-identical on every replica — grant order is
+        the certificate's own (wire) order, keys resolve from the committed
+        config the cert was formed under, and the replica's OWN grant is
+        included as a real verify rather than a local re-sign compare — so
+        the aggregate key memoizes CLUSTER-WIDE on a shared verifier: rf
+        replicas checking the same certificate cost one batched call total.
+        """
+        try:
+            cert_cfg = self.store.cert_config(wc)
+        except Exception:
+            return None
+        server_ids = list(wc.grants.keys())
+        if not server_ids:
+            return None
+        items: List[VerifyItem] = []
+        for sid in server_ids:
+            mg = wc.grants[sid]
+            key = cert_cfg.public_keys.get(sid)
+            if key is None or mg.signature is None or mg.server_id != sid:
+                return None
+            items.append(VerifyItem(key, mg.signing_bytes(), mg.signature))
+        return aggregate_key(items), items, server_ids
+
     def _dispatch_one(
         self,
         i: int,
@@ -1189,6 +1420,8 @@ class MochiReplica:
             return self._respond(env, HelloFromServer(f"{payload.message} back"))
         if isinstance(payload, SessionInitToServer):
             return self._session_init(env, payload)
+        if isinstance(payload, SessionCheckpointToServer):
+            return self._session_checkpoint(env, payload)
         if isinstance(payload, SyncRequestToServer):
             # Serve committed state for transfer.  No trust needed on
             # either side: entries are (transaction, certificate) pairs
@@ -1342,8 +1575,95 @@ class MochiReplica:
             responder_id=self.server_id,
             initiated=False,
         )
+        # Fresh session, fresh audit window: the sender's SessionWindow
+        # restarts with the new key, so a ledger carried across handshakes
+        # would demand coverage the sender can no longer give.
+        self._ckpt_ledgers.pop(env.sender_id, None)
         self.metrics.mark("replica.sessions-established")
         return ack
+
+    def _session_checkpoint(
+        self, env: Envelope, payload: SessionCheckpointToServer
+    ) -> Envelope:
+        """Verify a sender's signed checkpoint declaration against this
+        replica's accepted-envelope ledger (round 18).
+
+        The declaration MUST arrive Ed25519-signed — its signature is the
+        retroactive identity binding the whole fast path rests on — so a
+        MAC'd (or unsigned) checkpoint is by definition a downgrade attempt:
+        typed refusal + conviction, never a silent fallback.  A coverage
+        mismatch (this replica accepted a MAC'd envelope the sender never
+        signed for) is a forged or replayed MAC window: conviction with the
+        signed declaration as transferable evidence, typed BAD_CERTIFICATE,
+        and the session drops so state restarts clean."""
+        metrics = self.metrics
+        if env.mac is not None or env.signature is None:
+            metrics.mark("replica.checkpoint-downgrade")
+            self._convict(
+                "checkpoint-downgrade", env,
+                {"macd": env.mac is not None, "window": payload.window},
+            )
+            return self._respond(
+                env,
+                RequestFailedFromServer(
+                    FailType.BAD_REQUEST,
+                    "session checkpoints must be Ed25519-signed "
+                    "(MAC downgrade refused)",
+                ),
+                force_sign=True,
+            )
+        led = self._ckpt_ledgers.get(env.sender_id)
+        if led is None:
+            # No MAC'd envelope accepted since boot/handshake: trivially
+            # consistent — verify against an empty ledger so the declared
+            # digests still enter the carry (late arrivals stay covered).
+            led = session_crypto.CheckpointLedger()
+            self._ckpt_ledgers[env.sender_id] = led
+        if len(payload.digests) > session_crypto.CheckpointLedger.CARRY_MAX:
+            # bound the carry memory a single declaration can demand
+            self._drop_session(env.sender_id)
+            return self._respond(
+                env,
+                RequestFailedFromServer(
+                    FailType.BAD_REQUEST,
+                    "checkpoint declaration too large; re-establish session",
+                ),
+                force_sign=True,
+            )
+        accepted_before = led.count_since
+        reason = led.verify(payload.digests)
+        if reason == "carry overflow":
+            # pathological loss, not evidence: demand a fresh session
+            metrics.mark("replica.checkpoint-reset")
+            self._drop_session(env.sender_id)
+            return self._respond(
+                env,
+                RequestFailedFromServer(
+                    FailType.BAD_REQUEST,
+                    "session transcript unreconcilable; re-establish session",
+                ),
+                force_sign=True,
+            )
+        if reason is not None:
+            metrics.mark("replica.checkpoint-mismatch")
+            self._convict(
+                "checkpoint-mismatch", env,
+                {"reason": reason, "window": payload.window,
+                 "declared": len(payload.digests)},
+            )
+            self._drop_session(env.sender_id)
+            return self._respond(
+                env,
+                RequestFailedFromServer(
+                    FailType.BAD_CERTIFICATE,
+                    "checkpoint transcript mismatch: " + reason,
+                ),
+                force_sign=True,
+            )
+        metrics.mark("replica.checkpoints-verified")
+        return self._respond(
+            env, SessionCheckpointAckFromServer(payload.window, accepted_before)
+        )
 
     def _handle_write1_batch(
         self, envs: "Sequence[Envelope]", admin_ok: "Sequence[bool]"
@@ -1520,6 +1840,167 @@ class MochiReplica:
         with self.metrics.timer("replica.crypto-local"):
             return env.with_signature(self.keypair.sign(env.signing_bytes()))
 
+    # --------------------------------------------- peer MAC sessions (r18)
+
+    def _drop_peer_session(self, sid: str) -> None:
+        self._peer_sessions.pop(sid, None)
+        self._peer_windows.pop(sid, None)
+
+    async def _ensure_peer_session(
+        self, sid: str, info, timeout_s: float = 3.0
+    ) -> Optional[bytes]:
+        """Initiator side of a replica->replica MAC session: the same
+        SessionInit handshake clients use (the responder's _session_init
+        doesn't care who initiates), with the ack's Ed25519 signature
+        verified against the peer's MEMBERSHIP key — that signature is what
+        stops a MITM key substitution.  None = no session (refused, rate
+        limited, unreachable): the caller stays on signed envelopes, and a
+        failure TTL stops a refusing peer from buying a handshake storm."""
+        key = self._peer_sessions.get(sid)
+        if key is not None:
+            return key
+        if time.monotonic() < self._peer_hs_retry_at.get(sid, 0.0):
+            return None
+        lock = self._peer_hs_locks.setdefault(sid, asyncio.Lock())
+        async with lock:
+            key = self._peer_sessions.get(sid)  # raced handshake won
+            if key is not None:
+                return key
+            if time.monotonic() < self._peer_hs_retry_at.get(sid, 0.0):
+                return None
+            hs = session_crypto.new_handshake()
+            try:
+                res = await self.peer_pool.send_and_receive(
+                    info,
+                    self._signed_request(
+                        SessionInitToServer(hs.public_bytes, hs.nonce)
+                    ),
+                    timeout_s,
+                )
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                self._peer_hs_retry_at[sid] = time.monotonic() + 10.0
+                return None
+            ack = res.payload
+            peer_key = self.config.public_keys.get(sid)
+            sig_ok = False
+            if (
+                isinstance(ack, SessionAckFromServer)
+                and peer_key is not None
+                and res.signature is not None
+            ):
+                # pooled (non-blocking) verify: handshakes are rare, but a
+                # storm of them must not stall the event loop on host crypto
+                bitmap = await self._verify_counted(
+                    [VerifyItem(peer_key, res.signing_bytes(), res.signature)]
+                )
+                sig_ok = bool(bitmap[0])
+            if not sig_ok:
+                self.metrics.mark("replica.peer-handshake-refused")
+                self._peer_hs_retry_at[sid] = time.monotonic() + 10.0
+                return None
+            key = session_crypto.derive_key(
+                hs,
+                ack.x25519_public,
+                ack.nonce,
+                initiator_id=self.server_id,
+                responder_id=sid,
+                initiated=True,
+            )
+            self._peer_sessions[sid] = key
+            self._peer_windows[sid] = session_crypto.SessionWindow()
+            self.metrics.mark("replica.peer-sessions-established")
+            return key
+
+    async def _peer_checkpoint(
+        self, sid: str, info, timeout_s: float = 5.0
+    ) -> None:
+        """Flush this replica's sender-side checkpoint window for one peer
+        session: sign the declaration, retire it on a positive ack.  A
+        refused declaration (should never happen to an honest sender) drops
+        the session — state restarts clean on the next handshake."""
+        win = self._peer_windows.get(sid)
+        if win is None or not win.pending:
+            return
+        window, digests = win.take()
+        ticket = win  # the handle the taken digests belong to
+        try:
+            res = await self.peer_pool.send_and_receive(
+                info,
+                self._signed_request(SessionCheckpointToServer(window, digests)),
+                timeout_s,
+            )
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            return  # lost checkpoint: the window re-declares next flush
+        # Re-read after the await: a concurrent drop/re-handshake replaced
+        # the window, and the fresh one owns a NEW transcript — retiring
+        # these digests against it would corrupt it.
+        win = self._peer_windows.get(sid)
+        if win is None or win is not ticket:
+            return
+        if isinstance(res.payload, SessionCheckpointAckFromServer):
+            win.committed(len(digests))
+            self.metrics.mark("replica.peer-checkpoints")
+        elif isinstance(res.payload, RequestFailedFromServer):
+            self.metrics.mark("replica.peer-checkpoint-refused")
+            self._drop_peer_session(sid)
+
+    async def _peer_send(
+        self, sid: str, info, payload, timeout_s: float
+    ) -> Envelope:
+        """Send one peer request: MAC-sealed on an established session when
+        the fast path is on (with the sender-side checkpoint bookkeeping),
+        Ed25519-signed otherwise.  A stale-session BAD_SIGNATURE (the peer
+        restarted and lost its table) retries signed once and re-handshakes
+        lazily — same contract as the client SDK's fan-out."""
+        if self.fast_path:
+            key = await self._ensure_peer_session(sid, info)
+            if key is not None:
+                win = self._peer_windows.get(sid)
+                if win is not None and (win.due() or win.overdue_risk()):
+                    await self._peer_checkpoint(sid, info, timeout_s)
+                    key = self._peer_sessions.get(sid)
+                if key is not None:
+                    env = Envelope(
+                        payload=payload,
+                        msg_id=new_msg_id(),
+                        sender_id=self.server_id,
+                        timestamp_ms=int(time.time() * 1000),
+                    )
+                    with self.metrics.timer("replica.crypto-local"):
+                        env = session_crypto.seal(env, key)
+                    win = self._peer_windows.get(sid)
+                    if win is not None:
+                        win.note(env.signing_bytes())
+                    res = await self.peer_pool.send_and_receive(
+                        info, env, timeout_s
+                    )
+                    p = res.payload
+                    if (
+                        isinstance(p, RequestFailedFromServer)
+                        and p.fail_type == FailType.BAD_SIGNATURE
+                    ):
+                        self.metrics.mark("replica.peer-session-stale")
+                        self._drop_peer_session(sid)
+                    elif (
+                        isinstance(p, RequestFailedFromServer)
+                        and p.fail_type == FailType.BAD_REQUEST
+                        and "checkpoint" in p.detail
+                    ):
+                        self.metrics.mark("replica.peer-session-reset")
+                        self._drop_peer_session(sid)
+                    else:
+                        return res
+                    return await self.peer_pool.send_and_receive(
+                        info, self._signed_request(payload), timeout_s
+                    )
+        return await self.peer_pool.send_and_receive(
+            info, self._signed_request(payload), timeout_s
+        )
+
     async def resync(
         self, keys: Optional[Iterable[str]] = None, timeout_s: float = 5.0
     ) -> int:
@@ -1538,14 +2019,20 @@ class MochiReplica:
         """
         key_tuple = tuple(keys) if keys is not None else None
         page = 1024
-        peers = [
-            info
-            for sid, info in self.config.servers.items()
-            if sid != self.server_id
-        ]
         advanced_keys: set = set()
 
+        def peers_now():
+            # Re-read per pass: a mid-resync reconfig swaps the peer list
+            # under us, and every pulled entry is certificate-validated
+            # anyway, so the freshest membership can only improve coverage.
+            return [
+                (sid, info)
+                for sid, info in self.config.servers.items()
+                if sid != self.server_id
+            ]
+
         async def pull_peer(
+            sid,
             info,
             prefix: Optional[str],
             req_keys: "Optional[tuple]" = None,
@@ -1557,9 +2044,7 @@ class MochiReplica:
                     keys=req_keys, max_entries=page, after_key=after, prefix=prefix
                 )
                 try:
-                    res = await self.peer_pool.send_and_receive(
-                        info, self._signed_request(request), timeout_s
-                    )
+                    res = await self._peer_send(sid, info, request, timeout_s)
                 except asyncio.CancelledError:
                     raise
                 except Exception:
@@ -1571,24 +2056,49 @@ class MochiReplica:
                     # delta-vs-full transfer accounting (the round-14
                     # incremental anti-entropy evidence on storage_stats)
                     self.metrics.mark(f"replica.resync-{count}-keys", len(entries))
-                for entry in entries:
-                    if not self.store.owns(entry.key):
-                        continue
-                    checked = await self._check_certificate(entry.certificate)
+                # Verify-behind-the-ack, batched per page (round 18): the
+                # nudge/pull was acknowledged long ago; these checks run in
+                # the background worker, so the page's certificates verify
+                # CONCURRENTLY — on the fast path each is one memoized
+                # aggregate, usually the very attestation some replica
+                # already verified at Write2 time.  Adoption stays strictly
+                # after verification: speculative state adoption would
+                # trade safety for nothing.
+                owned = [e for e in entries if self.store.owns(e.key)]
+                if self.fast_path:
+                    # Warm the aggregate memo for the whole page at once;
+                    # the per-entry re-check below then hits the memo (no
+                    # second signature round trip).
+                    await asyncio.gather(
+                        *(
+                            self._check_certificate_fast(e.certificate)
+                            for e in owned
+                        )
+                    )
+                for entry in owned:
+                    checked = await self._check_certificate_fast(
+                        entry.certificate
+                    )
+                    if checked is None:
+                        # fast path off, aggregate ineligible, or a failed
+                        # aggregate: the attributing per-grant audit
+                        checked = await self._check_certificate(
+                            entry.certificate
+                        )
                     if checked is None:
                         self.metrics.mark("replica.resync-bad-certificate")
                         continue
-                    if self.store.apply_sync_entry(replace(entry, certificate=checked)):
+                    if self.store.apply_sync_entry(
+                        replace(entry, certificate=checked)
+                    ):
                         advanced_keys.add(entry.key)
                 if len(entries) < page:
                     return
                 after = entries[-1].key
 
-        async def digest_page(info, request) -> Optional[SyncDigestFromServer]:
+        async def digest_page(sid, info, request) -> Optional[SyncDigestFromServer]:
             try:
-                res = await self.peer_pool.send_and_receive(
-                    info, self._signed_request(request), timeout_s
-                )
+                res = await self._peer_send(sid, info, request, timeout_s)
             except asyncio.CancelledError:
                 raise
             except Exception:
@@ -1597,16 +2107,16 @@ class MochiReplica:
                 return None  # pre-round-14 peer (or refusal): caller falls back
             return res.payload
 
-        async def pull_peer_delta(info) -> None:
+        async def pull_peer_delta(sid, info) -> None:
             """Incremental anti-entropy (round 14): shard digests -> key
             digests for mismatched shards -> pull ONLY the differing keys.
             Peers that do not speak digests get the old full pull.  Digest
             comparisons are advisory (a lying peer causes a redundant or
             missed pull from ITSELF only); every transferred entry still
             re-validates through the Write2 path."""
-            res = await digest_page(info, SyncDigestRequestToServer())
+            res = await digest_page(sid, info, SyncDigestRequestToServer())
             if res is None or res.shards is None:
-                await pull_peer(info, None, None, count="full")
+                await pull_peer(sid, info, None, None, count="full")
                 return
             local_shards = {
                 t: (n, d) for t, n, d in self.store.export_shard_digests()
@@ -1644,6 +2154,7 @@ class MochiReplica:
             after: Optional[str] = None
             while True:
                 res = await digest_page(
+                    sid,
                     info,
                     SyncDigestRequestToServer(
                         tokens=tuple(mismatched), max_entries=4096, after_key=after
@@ -1666,7 +2177,7 @@ class MochiReplica:
                 self.metrics.mark("replica.resync-keys-matched", keys_matched)
             for i in range(0, len(delta), page):
                 await pull_peer(
-                    info, None, tuple(delta[i : i + page]), count="delta"
+                    sid, info, None, tuple(delta[i : i + page]), count="delta"
                 )
 
         with self.metrics.timer("replica.resync"):
@@ -1690,8 +2201,10 @@ class MochiReplica:
                 # _CONFIG_CLUSTER_CS_* rungs; the prefix bounds the sweep.
                 for _ in range(2):
                     await asyncio.gather(
-                        # mochi-lint: disable=await-races -- stable peer snapshot by design: every pulled entry is certificate-validated, so a mid-resync reconfig can only shrink coverage, never corrupt state
-                        *(pull_peer(info, CONFIG_KEY_PREFIX, None) for info in peers)
+                        *(
+                            pull_peer(sid, info, CONFIG_KEY_PREFIX, None)
+                            for sid, info in peers_now()
+                        )
                     )
             # Pass 2: the requested keys (config keys re-apply as no-ops).
             # A FULL resync (keys=None) goes digest-first — per-shard
@@ -1700,10 +2213,15 @@ class MochiReplica:
             # replica ships deltas instead of the whole store; targeted
             # resyncs already name their keys.
             if key_tuple is None:
-                await asyncio.gather(*(pull_peer_delta(info) for info in peers))
+                await asyncio.gather(
+                    *(pull_peer_delta(sid, info) for sid, info in peers_now())
+                )
             else:
                 await asyncio.gather(
-                    *(pull_peer(info, None, key_tuple) for info in peers)
+                    *(
+                        pull_peer(sid, info, None, key_tuple)
+                        for sid, info in peers_now()
+                    )
                 )
         if advanced_keys:
             LOG.info("resync advanced %d objects", len(advanced_keys))
@@ -1940,3 +2458,103 @@ class MochiReplica:
         items = prep[2]
         bitmap = await self._verify_counted(items) if items else []
         return self._finish_certificate(wc, prep, bitmap)
+
+    async def _check_certificate_fast(
+        self, wc: WriteCertificate
+    ) -> Optional[WriteCertificate]:
+        """Aggregate-only certificate check (round 18 resync path): the
+        one-attestation verify, memoized cluster-wide by certificate hash,
+        so a resync page of certs the cluster already committed costs zero
+        signature verifies.  Returns None when the fast path is off, the
+        aggregate is ineligible, or it FAILS — callers must then audit via
+        ``_check_certificate`` (the attributing per-grant path) before any
+        adoption; this method never adopts on failure itself."""
+        if not self.fast_path:
+            return None
+        agg = self._aggregate_items(wc)
+        if agg is None:
+            return None
+        akey, aitems, _server_ids = agg
+        try:
+            ok = await self._verify_aggregate_counted(akey, aitems)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            ok = False
+        if ok:
+            self._note_grant_evidence(wc.grants.values())
+            return WriteCertificate(dict(wc.grants))
+        # Someone in the grant set lied (or the cert is malformed): the
+        # caller pays the per-item audit so the conviction machinery can
+        # attribute WHICH grant was bad.
+        self.metrics.mark("replica.cert-agg-audit")
+        return None
+
+    def fastpath_stats(self) -> Dict[str, object]:
+        """Round-18 fast-path observability: session/checkpoint posture and
+        aggregate-verify effectiveness, for the admin surface and the r18
+        benchmark record."""
+        v = self.verifier
+        return {
+            "fast_path": self.fast_path,
+            "client_sessions": len(self._sessions),
+            "peer_sessions": len(self._peer_sessions),
+            "checkpoint_ledgers": {
+                sid: led.stats() for sid, led in self._ckpt_ledgers.items()
+            },
+            "peer_windows": {
+                sid: {"pending": len(win.pending), "window": win.window,
+                      "sent": win.sent}
+                for sid, win in self._peer_windows.items()
+            },
+            "checkpoints_verified": self.metrics.counters.get(
+                "replica.checkpoints-verified", 0
+            ),
+            "checkpoint_mismatches": self.metrics.counters.get(
+                "replica.checkpoint-mismatch", 0
+            ),
+            "cert_agg_verified": self.metrics.counters.get(
+                "replica.cert-agg-verified", 0
+            ),
+            "cert_agg_audits": self.metrics.counters.get(
+                "replica.cert-agg-audit", 0
+            ),
+            "agg_hits": getattr(v, "agg_hits", None),
+            "agg_misses": getattr(v, "agg_misses", None),
+        }
+
+
+# --------------------------------------------------------------------------
+# wire-taint registration (round 18).  The fast path removes per-message
+# Ed25519 from the hot path; the lattice only tolerates that because each
+# replacement check is a registered sanitizer.  Registered via the runtime
+# API so the registry-rot tripwire owns them: rename any of these methods
+# without updating this block and the full-tree scan reports registry-rot.
+# MAC-session envelope auth itself rides the builtin "session-mac"
+# (_auth_mac) / "session-mac-fn" (mac_ok) edges.
+from ..analysis import wire_taint  # noqa: E402  (import at registration site)
+
+wire_taint.register_verifier_edge(
+    "cert-aggregate-verify", "_verify_aggregate_counted",
+    [wire_taint.CLS_CERT],
+    note="one-attestation write certificate: the 2f+1 grant set verifies "
+         "as a single batched-EdDSA aggregate, memoized cluster-wide by "
+         "cert hash (failure falls back to per-item audit attribution)",
+    expect_live=True,
+)
+wire_taint.register_verifier_edge(
+    "cert-aggregate-resync", "_check_certificate_fast",
+    [wire_taint.CLS_CERT],
+    note="resync/anti-entropy aggregate-first certificate recheck; audits "
+         "through _check_certificate (the builtin certificate-recheck edge) "
+         "on aggregate failure",
+    expect_live=True,
+)
+wire_taint.register_verifier_edge(
+    "checkpoint-transcript-verify", "_session_checkpoint",
+    [wire_taint.CLS_ENV],
+    note="signed checkpoint declaration vs the replica's accepted-envelope "
+         "ledger: retroactive conviction for MAC-window tampering; "
+         "MAC'd/unsigned declarations refuse as downgrade attempts",
+    expect_live=True,
+)

@@ -57,6 +57,15 @@ Strategy catalog (``make_strategy`` names):
     this is the reconfiguration-churn shape: transient quorum loss, retry
     pressure, background sync traffic.
 
+``session-attack``
+    Round-18 fast-path adversary: establishes a REAL peer MAC session with
+    a victim (the attacker is in-set, so the signed handshake succeeds
+    honestly) and then attacks the session machinery itself — MAC-window
+    mutation, cross-checkpoint replay, checkpoint downgrade, and riding
+    the MAC discount past the overdue cap.  Every probe must end in a
+    TYPED refusal or a conviction on the victim; a silent fallback to the
+    signed path without evidence is the bug the probes exist to catch.
+
 All strategies are deterministic given their seed (the config-10 record is
 reproducible run over run on the same netsim seed).
 """
@@ -66,9 +75,12 @@ from __future__ import annotations
 import asyncio
 import logging
 import random
+import time
 from dataclasses import replace
 from typing import Dict, List, Optional, Sequence
 
+from ..crypto import session as session_crypto
+from ..net.transport import new_msg_id
 from ..protocol import (
     Envelope,
     Grant,
@@ -76,8 +88,10 @@ from ..protocol import (
     NudgeSyncToServer,
     OperationResult,
     ReadFromServer,
+    SessionCheckpointToServer,
     Status,
     SyncEntriesFromServer,
+    SyncRequestToServer,
     TransactionResult,
     Write1OkFromServer,
     Write1RefusedFromServer,
@@ -88,7 +102,10 @@ from ..server.replica import MochiReplica
 
 LOG = logging.getLogger(__name__)
 
-STRATEGIES = ("equivocate", "forge-cert", "stale-replay", "silent", "storm")
+STRATEGIES = (
+    "equivocate", "forge-cert", "stale-replay", "silent", "storm",
+    "session-attack",
+)
 
 
 class AttackStrategy:
@@ -306,6 +323,115 @@ class StormStrategy(AttackStrategy):
                     pass  # flood is best-effort; partitions drop it
 
 
+class SessionAttackStrategy(AttackStrategy):
+    """Round-18 fast-path adversary.  Passive on the serving seams (it
+    answers honestly); the attack surface is a set of ACTIVE probes the
+    tests drive deterministically, each abusing a real peer MAC session
+    with the victim:
+
+    - :meth:`tamper_mac_window` — mutate a sealed envelope's payload after
+      sealing (in-flight MAC-window mutation).  The victim must answer a
+      typed BAD_SIGNATURE and record a ``mac-tamper`` conviction.
+    - :meth:`replay_across_window` — deliver one sealed envelope TWICE but
+      sign a declaration covering it once.  The victim's checkpoint ledger
+      counts two; the signed transcript convicts (``checkpoint-mismatch``,
+      typed BAD_CERTIFICATE) and the session drops.
+    - :meth:`downgrade_checkpoint` — declare a checkpoint under session
+      MAC instead of an Ed25519 signature (the forced signature→MAC
+      downgrade).  Typed BAD_REQUEST + ``checkpoint-downgrade`` conviction;
+      never a silent fallback.
+    - :meth:`overdue_flood` — ride the MAC discount without ever signing a
+      transcript declaration.  Past ``OVERDUE_FACTOR`` windows the victim
+      refuses typed (BAD_REQUEST policy refusal) and drops the session.
+    """
+
+    name = "session-attack"
+
+    async def _session(self, victim_sid: str):
+        r = self.replica
+        assert r is not None
+        info = r.config.servers[victim_sid]
+        key = await r._ensure_peer_session(victim_sid, info)
+        if key is None:
+            raise RuntimeError(f"no peer MAC session with {victim_sid}")
+        return info, key
+
+    def _sealed(self, payload, key) -> Envelope:
+        assert self.replica is not None
+        env = Envelope(
+            payload=payload,
+            msg_id=new_msg_id(),
+            sender_id=self.replica.server_id,
+            timestamp_ms=int(time.time() * 1000),
+        )
+        return session_crypto.seal(env, key)
+
+    async def tamper_mac_window(
+        self, victim_sid: str, timeout_s: float = 2.0
+    ) -> Envelope:
+        """Seal honestly, then swap the payload — the bytes a MITM (or a
+        buggy sender) would deliver inside an established MAC window."""
+        info, key = await self._session(victim_sid)
+        sealed = self._sealed(
+            SyncRequestToServer(keys=("honest",), max_entries=1), key
+        )
+        evil = replace(
+            sealed,
+            payload=SyncRequestToServer(keys=("tampered",), max_entries=1),
+        )
+        return await self.replica.peer_pool.send_and_receive(
+            info, evil, timeout_s
+        )
+
+    async def replay_across_window(
+        self, victim_sid: str, timeout_s: float = 2.0
+    ):
+        """Deliver one sealed envelope twice, declare it once, checkpoint:
+        returns (first_response, second_response); the conviction lands on
+        the victim when the signed declaration under-covers its ledger."""
+        r = self.replica
+        assert r is not None
+        info, key = await self._session(victim_sid)
+        sealed = self._sealed(
+            SyncRequestToServer(keys=("replayed",), max_entries=1), key
+        )
+        win = r._peer_windows.get(victim_sid)
+        if win is not None:
+            win.note(sealed.signing_bytes())  # signed for ONCE
+        first = await r.peer_pool.send_and_receive(info, sealed, timeout_s)
+        second = await r.peer_pool.send_and_receive(info, sealed, timeout_s)
+        await r._peer_checkpoint(victim_sid, info, timeout_s)
+        return first, second
+
+    async def downgrade_checkpoint(
+        self, victim_sid: str, timeout_s: float = 2.0
+    ) -> Envelope:
+        """A MAC'd transcript declaration: whoever holds the session key
+        could forge it, which is exactly the adversary checkpoints exist
+        to convict — the victim must refuse typed and convict."""
+        info, key = await self._session(victim_sid)
+        return await self.replica.peer_pool.send_and_receive(
+            info, self._sealed(SessionCheckpointToServer(0, ()), key), timeout_s
+        )
+
+    async def overdue_flood(
+        self, victim_sid: str, n: int, timeout_s: float = 2.0
+    ) -> Optional[Envelope]:
+        """Send ``n`` distinct MAC'd envelopes and never declare any of
+        them; returns the last response (typed BAD_REQUEST once past the
+        overdue cap)."""
+        info, key = await self._session(victim_sid)
+        last: Optional[Envelope] = None
+        for i in range(n):
+            sealed = self._sealed(
+                SyncRequestToServer(keys=(f"od-{i}",), max_entries=1), key
+            )
+            last = await self.replica.peer_pool.send_and_receive(
+                info, sealed, timeout_s
+            )
+        return last
+
+
 def make_strategy(spec, seed: int = 0) -> AttackStrategy:
     """Resolve a strategy name (or pass an instance through)."""
     if isinstance(spec, AttackStrategy):
@@ -317,6 +443,7 @@ def make_strategy(spec, seed: int = 0) -> AttackStrategy:
         "forge-cert": ForgeCertStrategy,
         "stale-replay": StaleReplayStrategy,
         "storm": StormStrategy,
+        "session-attack": SessionAttackStrategy,
     }
     try:
         return table[spec](seed=seed)

@@ -80,7 +80,11 @@ from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 # v2: durable draws gained the ``engine`` dimension (wal vs paged, round
 # 17) — a new "engine" stream, so v1 seeds draw identical topologies and
 # faults, but the spec shape changed and pinned specs re-pin.
-GENERATOR_VERSION = 2
+# v3: every draw gained the ``fast_path`` dimension (session MAC fast
+# path on vs off, round 18) — again a new stream ("fastpath"), so v2
+# seeds draw identical everything-else; the soak battery now covers both
+# verification postures.
+GENERATOR_VERSION = 3
 
 # The fault families a seed can draw.  "sigkill" only appears on the
 # process backend (a real SIGKILL needs a real process); everything else
@@ -155,6 +159,11 @@ class ScenarioSpec:
     # which durable engine the storage dir gets ("wal" | "paged", round
     # 17); meaningless unless durable
     engine: str = "wal"
+    # session MAC fast path posture (round 18): True = MAC'd sessions +
+    # signed checkpoints + one-attestation certificates; False = every
+    # envelope Ed25519-signed and every grant checked (the pre-r18 wire).
+    # Pinned in the spec so a replay never depends on MOCHI_FAST_PATH.
+    fast_path: bool = True
     # netsim shape (the LinkEvent schedule is implied by the fault legs —
     # the engine fires partition/heal/degrade events at leg barriers)
     net_seed: int = 0
@@ -235,6 +244,11 @@ def draw_spec(seed: int, profile: str = "soak") -> ScenarioSpec:
     # Separate stream (not a draw on topo_rng): existing components keep
     # their exact v1 draws — the engine dimension is purely additive.
     engine_rng = _stream(seed, "engine")
+    # v3 (round 18), same additive-stream discipline: the fast-path
+    # posture rides its own stream.  50/50 — the signed-everything wire
+    # is the safety argument's baseline and must keep equal soak weight.
+    fp_rng = _stream(seed, "fastpath")
+    fast_path = fp_rng.random() < 0.5
 
     # ~1 in 8 seeds buys a real-process SIGKILL scenario: OS processes,
     # durable storage, kill -9 the whole cluster mid-load, recover from
@@ -250,6 +264,7 @@ def draw_spec(seed: int, profile: str = "soak") -> ScenarioSpec:
             durable=True,
             wal_fsync="group",
             engine=engine_rng.choice(("wal", "paged")),
+            fast_path=fast_path,
             n_clients=1,
             keys_per_client=3 + wl_rng.randrange(3),
             sweeps=1,
@@ -360,6 +375,7 @@ def draw_spec(seed: int, profile: str = "soak") -> ScenarioSpec:
         durable=durable,
         wal_fsync=wal_fsync,
         engine=engine,
+        fast_path=fast_path,
         net_seed=seed,
         rtt_ms=rtt_ms,
         jitter_ms=jitter_ms,
@@ -753,7 +769,8 @@ async def _drive_virtual(spec: ScenarioSpec, res: ScenarioResult, storage_dir: O
     )
     res.steps.append(
         f"topology: n={spec.n_servers} rf={spec.rf} f={spec.f} "
-        f"durable={spec.durable} engine={spec.engine} backend=virtual"
+        f"durable={spec.durable} engine={spec.engine} "
+        f"fast_path={spec.fast_path} backend=virtual"
     )
     res.steps.append(
         f"netsim: rtt={spec.rtt_ms}ms jitter={spec.jitter_ms}ms drop={spec.drop}"
@@ -769,6 +786,7 @@ async def _drive_virtual(spec: ScenarioSpec, res: ScenarioResult, storage_dir: O
             byzantine=byz_map or None,
             storage_dir=storage_dir,
             storage_engine=spec.engine if spec.durable else None,
+            fast_path=spec.fast_path,
         ) as vc:
             checker = InvariantChecker(vc.honest_replicas(), sorted(byz_map))
             clients = [
@@ -792,6 +810,14 @@ async def _drive_virtual(spec: ScenarioSpec, res: ScenarioResult, storage_dir: O
             res.report = checker.report()
             res.violations = _normalized_violations(checker.violations)
             res.info["netsim_totals"] = sim.totals()
+            # evidence the drawn posture actually landed on every node
+            # (a spec that said fast_path=False while the cluster ran
+            # MAC'd sessions would soak the wrong wire)
+            res.info["fast_path_postures"] = {
+                "spec": spec.fast_path,
+                "replicas": sorted({bool(r.fast_path) for r in vc.replicas}),
+                "clients": sorted({bool(c.fast_path) for c in clients}),
+            }
     finally:
         transport.RTT_FLOOR_S = prev_floor
     res.steps.append(
@@ -813,7 +839,8 @@ async def _drive_process(spec: ScenarioSpec, res: ScenarioResult) -> None:
     fault = spec.faults[0]
     res.steps.append(
         f"topology: n={spec.n_servers} rf={spec.rf} f={spec.f} "
-        f"durable=True engine={spec.engine} backend=process"
+        f"durable=True engine={spec.engine} "
+        f"fast_path={spec.fast_path} backend=process"
     )
     res.steps.append(f"L0: sigkill {json.dumps(fault, sort_keys=True)}")
     async with ProcessCluster(
@@ -823,11 +850,15 @@ async def _drive_process(spec: ScenarioSpec, res: ScenarioResult) -> None:
         storage_dir=True,
         wal_fsync=spec.wal_fsync,
         storage_engine=spec.engine,
+        # the children resolve their posture from the env (no --fast-path
+        # flag): pin it so the replay never depends on the runner's env
+        env={"MOCHI_FAST_PATH": "1" if spec.fast_path else "0"},
     ) as pc:
         client = pc.client(
             timeout_s=spec.timeout_s,
             client_id=f"scn-{spec.seed}-c0",
             rng_seed=spec.seed * 1000,
+            fast_path=spec.fast_path,
         )
         await _burst([client], None, "warm", spec, res)
         victims = [f"server-{i}" for i in range(int(fault.get("victims", 1)))]

@@ -72,6 +72,10 @@ class VirtualCluster:
         # Which durable engine a storage_dir gets: "wal" (default) or
         # "paged" (round 17) — None defers to MOCHI_STORAGE_ENGINE.
         storage_engine: Optional[str] = None,
+        # Session MAC fast path posture (round 18), threaded into every
+        # replica AND every vc.client() SDK instance so one knob pins the
+        # whole cluster.  None (default) defers to MOCHI_FAST_PATH.
+        fast_path: Optional[bool] = None,
     ):
         self.n_servers = n_servers
         self.rf = rf
@@ -85,6 +89,7 @@ class VirtualCluster:
         self.byzantine: Dict[str, object] = dict(byzantine or {})
         self.storage_dir = storage_dir
         self.storage_engine = storage_engine
+        self.fast_path = fast_path
         # Unix-domain sockets instead of loopback TCP (per-replica socket
         # files under this dir): skips the TCP/IP stack on the kernel send
         # path, the measured cost floor for single-host clusters
@@ -188,6 +193,7 @@ class VirtualCluster:
             netsim=self.netsim,
             storage_dir=self.storage_dir,
             storage_engine=self.storage_engine,
+            fast_path=self.fast_path,
             **kwargs,
         )
         strategy = self.byzantine.get(sid)
@@ -207,6 +213,8 @@ class VirtualCluster:
 
     def client(self, **kwargs) -> MochiDBClient:
         assert self.config is not None, "cluster not started"
+        if self.fast_path is not None:
+            kwargs.setdefault("fast_path", self.fast_path)
         if self.netsim is not None and "netsim" not in kwargs:
             kwargs["netsim"] = self.netsim
         if kwargs.get("netsim") is not None:

@@ -19,6 +19,7 @@ SURVEY.md §2.4).  Here every replica routes signature checks through this SPI:
 from __future__ import annotations
 
 import asyncio
+import hashlib
 import logging
 from dataclasses import dataclass
 from typing import Callable, List, Optional, Sequence, Tuple
@@ -37,11 +38,47 @@ class VerifyItem:
     signature: bytes  # 64 bytes
 
 
+def aggregate_key(items: Sequence[VerifyItem]) -> bytes:
+    """Collision-resistant digest of an ORDERED verification set — the memo
+    key for :meth:`SignatureVerifier.verify_aggregate`.  Length-prefixed so
+    (pub, msg, sig) boundaries can't be shifted between items; callers that
+    want cluster-wide memo hits (round 18: one attestation per write
+    certificate) must build the item list deterministically (grant order =
+    certificate order)."""
+    h = hashlib.sha256(b"mochi.agg.v1\x00")
+    for it in items:
+        for part in (it.public_key, it.message, it.signature):
+            h.update(len(part).to_bytes(4, "big"))
+            h.update(part)
+    return h.digest()
+
+
 class SignatureVerifier:
     """SPI: verify a batch, returning a validity bitmap (one bool per item)."""
 
     async def verify_batch(self, items: Sequence[VerifyItem]) -> List[bool]:
         raise NotImplementedError
+
+    async def verify_aggregate(
+        self, key: bytes, items: Sequence[VerifyItem]
+    ) -> bool:
+        """Verify an all-or-nothing attestation SET under one memo key.
+
+        The round-18 certificate fast path: a write certificate's 2f+1
+        grants are one logical artifact — every receiving replica needs the
+        same yes/no, not 2f+1 independent verdicts.  ``key`` MUST be a
+        collision-resistant digest of ``items`` (:func:`aggregate_key`), so
+        the verdict is a pure function of the key and caching/memoizing it
+        cluster-wide is sound.  Default: one batched ``verify_batch`` round
+        trip (batched EdDSA beats pairing aggregation at committee sizes —
+        arXiv 2302.00418); :class:`CachingVerifier` overrides with an
+        aggregate memo that counts ONE unique check per certificate.
+        A False verdict says only "not all valid" — callers that need
+        attribution fall back to the per-item path.
+        """
+        if not items:
+            return True
+        return all(await self.verify_batch(items))
 
     async def close(self) -> None:
         pass
@@ -193,6 +230,14 @@ class CoalescingVerifier(SignatureVerifier):
             assert self._inflight is not None
             self._inflight.release()
 
+    async def verify_aggregate(
+        self, key: bytes, items: Sequence[VerifyItem]
+    ) -> bool:
+        # Route to the inner verifier so a wrapped CachingVerifier's
+        # aggregate memo still answers in one entry; an aggregate is already
+        # one round trip, so there is nothing here to coalesce.
+        return await self.inner.verify_aggregate(key, items)
+
     async def close(self) -> None:
         if self._flush_task is not None and not self._flush_task.done():
             try:
@@ -238,6 +283,14 @@ class CachingVerifier(SignatureVerifier):
         self._inflight: "dict[Tuple[bytes, bytes, bytes], asyncio.Future]" = {}
         self.hits = 0
         self.misses = 0
+        # Aggregate memo (round 18): cert-hash -> all-valid verdict.  Kept
+        # SEPARATE from the per-item cache so one certificate counts as ONE
+        # unique check in the hits/misses meter regardless of quorum size —
+        # that ratio IS the live verifies/txn meter (config7_wan).
+        self._agg: "dict[bytes, bool]" = {}
+        self._agg_inflight: "dict[bytes, asyncio.Future]" = {}
+        self.agg_hits = 0
+        self.agg_misses = 0
 
     async def verify_batch(self, items: Sequence[VerifyItem]) -> List[bool]:
         out: List[Optional[bool]] = [None] * len(items)
@@ -309,6 +362,64 @@ class CachingVerifier(SignatureVerifier):
                 (ok,) = await self.verify_batch([items[i]])
             out[i] = bool(ok)
         return [bool(b) for b in out]
+
+    async def verify_aggregate(
+        self, key: bytes, items: Sequence[VerifyItem]
+    ) -> bool:
+        """One memo entry — and ONE hits/misses tick — per attestation set.
+
+        The miss path dispatches straight to ``self.inner.verify_batch``
+        (bypassing the per-item cache) so the 2f+1 constituent checks don't
+        ALSO land in the per-item meter: with the fast path on, a write
+        certificate is one unique check cluster-wide, which is exactly the
+        claim the live meter must be able to falsify.  Single-flight the
+        same way as items: all rf replicas of a set ask about the same
+        certificate within one batching window.
+        """
+        if not items:
+            return True
+        key = bytes(key)
+        cached = self._agg.get(key)
+        if cached is not None:
+            self.agg_hits += 1
+            self.hits += 1
+            return cached
+        fut = self._agg_inflight.get(key)
+        if fut is not None:
+            self.agg_hits += 1
+            self.hits += 1
+            ok = await fut
+            if ok is None:  # dispatcher failed: verify ourselves (re-enters)
+                return await self.verify_aggregate(key, items)
+            return bool(ok)
+        self.agg_misses += 1
+        self.misses += 1
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        self._agg_inflight[key] = fut
+        try:
+            bitmap = await self.inner.verify_batch(items)
+            if len(bitmap) != len(items):
+                raise RuntimeError(
+                    f"inner verifier returned {len(bitmap)} verdicts "
+                    f"for {len(items)} items"
+                )
+        except BaseException:
+            # same retry-sentinel contract as verify_batch's failure path
+            # (single-flight owner: only the caller that registered the
+            # future ever pops it)
+            self._agg_inflight.pop(key, None)
+            if not fut.done():
+                fut.set_result(None)
+            raise
+        verdict = all(bool(b) for b in bitmap)
+        if len(self._agg) >= self.max_entries:
+            self._agg.pop(next(iter(self._agg)))
+        self._agg[key] = verdict
+        self._agg_inflight.pop(key, None)
+        if not fut.done():
+            fut.set_result(verdict)
+        return verdict
 
     async def close(self) -> None:
         await self.inner.close()
@@ -469,6 +580,8 @@ def verifier_stats(verifier) -> dict:
         "fallback_batches",
         "hits",
         "misses",
+        "agg_hits",     # CachingVerifier: one-attestation certificate memo
+        "agg_misses",
         "calls",        # CoalescingVerifier: caller-side verify_batch calls
         "inner_calls",  # ...vs inner round trips (calls/inner_calls = merge ratio)
     ):

@@ -13,6 +13,8 @@ import pytest
 
 from mochi_tpu.client import TransactionBuilder
 from mochi_tpu.protocol import (
+    FailType,
+    RequestFailedFromServer,
     Write1OkFromServer,
     Write1ToServer,
     Write2ToServer,
@@ -413,5 +415,122 @@ def test_process_cluster_byzantine_silent_commits_cross_process():
                 + client.metrics.counters.get("suspect.no-response.server-1", 0)
                 > 0
             ), dict(client.metrics.counters)
+
+    run(main())
+
+
+# ------------------------------------------------------------------ round 18
+# Fast-path downgrade/tamper probes (session-attack strategy): every attack
+# on the MAC session machinery must end in a TYPED refusal or a conviction
+# with flight-recorder evidence — never a silent fallback.
+
+
+def test_session_attack_mac_tamper_typed_refusal_and_conviction(tmp_path):
+    """MAC-window mutation: a sealed envelope whose payload was swapped
+    after sealing gets a typed BAD_SIGNATURE, a mac-tamper conviction mark,
+    and a flight-recorder dump naming the evidence."""
+
+    async def main():
+        async with VirtualCluster(
+            4, rf=4, byzantine={"server-1": "session-attack"}
+        ) as vc:
+            victim = vc.replica("server-0")
+            victim.tracer.flight_dir = str(tmp_path)
+            strat = vc.replica("server-1").strategy
+            res = await strat.tamper_mac_window("server-0")
+            assert isinstance(res.payload, RequestFailedFromServer), res.payload
+            assert res.payload.fail_type == FailType.BAD_SIGNATURE
+            assert victim.metrics.counters.get("replica.mac-tamper", 0) >= 1
+            dumps = list(tmp_path.glob("flight-*.json"))
+            assert dumps, "conviction must leave flight-recorder evidence"
+            assert any("mac-tamper" in p.read_text() for p in dumps)
+
+    run(main())
+
+
+def test_session_attack_replay_across_window_convicted(tmp_path):
+    """Cross-checkpoint replay: one sealed envelope delivered twice but
+    signed for once.  Both deliveries authenticate (the MAC is genuine);
+    the signed transcript then under-covers the victim's ledger — a
+    checkpoint-mismatch conviction, flight evidence, and the session
+    drops on BOTH sides."""
+
+    async def main():
+        async with VirtualCluster(
+            4, rf=4, byzantine={"server-1": "session-attack"}
+        ) as vc:
+            victim = vc.replica("server-0")
+            victim.tracer.flight_dir = str(tmp_path)
+            byz = vc.replica("server-1")
+            first, second = await byz.strategy.replay_across_window("server-0")
+            assert not isinstance(first.payload, RequestFailedFromServer)
+            assert not isinstance(second.payload, RequestFailedFromServer)
+            assert victim.metrics.counters.get(
+                "replica.checkpoint-mismatch", 0
+            ) >= 1
+            # the refusal was typed back to the (Byzantine) sender, which
+            # dropped its side of the session per the honest-sender contract
+            assert byz.metrics.counters.get(
+                "replica.peer-checkpoint-refused", 0
+            ) >= 1
+            assert "server-1" not in victim._sessions
+            assert "server-0" not in byz._peer_sessions
+            dumps = list(tmp_path.glob("flight-*.json"))
+            assert any("checkpoint-mismatch" in p.read_text() for p in dumps)
+
+    run(main())
+
+
+def test_session_attack_downgrade_checkpoint_refused_typed(tmp_path):
+    """Forced signature→MAC downgrade: a transcript declaration arriving
+    under session MAC (forgeable by whoever holds the session key) must be
+    refused typed (BAD_REQUEST, named detail) and convicted — the silent
+    acceptance would void the whole retroactive-conviction design."""
+
+    async def main():
+        async with VirtualCluster(
+            4, rf=4, byzantine={"server-1": "session-attack"}
+        ) as vc:
+            victim = vc.replica("server-0")
+            victim.tracer.flight_dir = str(tmp_path)
+            strat = vc.replica("server-1").strategy
+            res = await strat.downgrade_checkpoint("server-0")
+            assert isinstance(res.payload, RequestFailedFromServer), res.payload
+            assert res.payload.fail_type == FailType.BAD_REQUEST
+            assert "Ed25519-signed" in res.payload.detail
+            assert victim.metrics.counters.get(
+                "replica.checkpoint-downgrade", 0
+            ) >= 1
+            dumps = list(tmp_path.glob("flight-*.json"))
+            assert any("checkpoint-downgrade" in p.read_text() for p in dumps)
+
+    run(main())
+
+
+def test_session_attack_overdue_flood_typed_policy_refusal(monkeypatch):
+    """Riding the MAC discount without ever signing a declaration: past
+    OVERDUE_FACTOR checkpoint windows the victim refuses typed
+    (BAD_REQUEST policy refusal, not BAD_SIGNATURE — there is no forgery)
+    and drops the session so the sender must re-handshake."""
+    from mochi_tpu.crypto import session as session_crypto
+
+    monkeypatch.setattr(session_crypto, "CHECKPOINT_MSGS", 2)
+
+    async def main():
+        async with VirtualCluster(
+            4, rf=4, byzantine={"server-1": "session-attack"}
+        ) as vc:
+            victim = vc.replica("server-0")
+            strat = vc.replica("server-1").strategy
+            # cap = OVERDUE_FACTOR (4) * CHECKPOINT_MSGS (2) = 8 accepted
+            # MAC'd envelopes; the 9th is the policy refusal
+            last = await strat.overdue_flood("server-0", n=9)
+            assert isinstance(last.payload, RequestFailedFromServer), last.payload
+            assert last.payload.fail_type == FailType.BAD_REQUEST
+            assert "overdue" in last.payload.detail
+            assert victim.metrics.counters.get(
+                "replica.checkpoint-overdue", 0
+            ) >= 1
+            assert "server-1" not in victim._sessions
 
     run(main())

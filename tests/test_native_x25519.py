@@ -1,0 +1,177 @@
+"""Differential suite for the native-C X25519 ladder (native/hbatch.c
+x25519) vs the pure-Python reference (crypto/hostfallback) and, when the
+wheel is installed, OpenSSL — the round-18 handshake fast path.
+
+The contract is *agreement*: both sides of a session handshake must derive
+the same MAC key whatever engine each runs (mixed clusters are supported by
+design — crypto/session.Handshake dispatches per side), and the rejection
+policy for malformed or small-order peer points must be identical, or a
+Byzantine peer could craft a handshake one engine accepts and the other
+refuses.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from mochi_tpu.crypto import hostfallback as hf
+from mochi_tpu.crypto import session as session_crypto
+from mochi_tpu.native import get_hbatch
+
+hb = get_hbatch()
+pytestmark = pytest.mark.skipif(
+    hb is None or not hasattr(hb, "x25519"),
+    reason="no native toolchain / x25519 engine",
+)
+
+try:  # optional third engine: OpenSSL via the cryptography wheel
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey,
+        X25519PublicKey,
+    )
+
+    def openssl_x25519(private: bytes, peer_public: bytes) -> bytes:
+        return X25519PrivateKey.from_private_bytes(private).exchange(
+            X25519PublicKey.from_public_bytes(peer_public)
+        )
+
+except ImportError:
+    openssl_x25519 = None
+
+# RFC 7748 §5.2 scalar-multiplication vectors
+VEC1 = (
+    "a546e36bf0527c9d3b16154b82465edd62144c0ac1fc5a18506a2244ba449ac4",
+    "e6db6867583030db3594c1a424b15f7c726624ec26b3353b10a903a6d0ab1c4c",
+    "c3da55379de9c6908e94ea4df28d084f32eccf03491c71f754b4075577a28552",
+)
+VEC2 = (
+    "4b66e9d4d1b4673c5ad22691957d6af5c11b6421e0ea01d42ca4169e7918ba0d",
+    "e5210f12786811d3f4b7959d0538ae2c31dbe7106fc03c3efc4cd549c715a493",
+    "95cbde9476e8907d7aade45cb4b873f88b595a68799fa152e6f8f7647aac7957",
+)
+# RFC 7748 §6.1 Diffie-Hellman vector
+DH_ALICE = "77076d0a7318a57d3c16c17251b26645df4c2f87ebc0992ab177fba51db92c2a"
+DH_BOB = "5dab087e624a8a4b79e17f8b83800ee66f3bb1292618b6fd1c2f8b27ff88e0eb"
+DH_ALICE_PUB = "8520f0098930a754748b7ddcb43ef75a0dbf3a0d26381af4eba4a98eaa9b4e6a"
+DH_BOB_PUB = "de9edb7d7b7dc1b4d35b61c2ece435373f8343c85b78674dadfc7e146f882b4f"
+DH_K = "4a5d9d5ba4ce2de1728e3bf480350f25e07e21c947d19e3376f09b3c1e161742"
+
+BASEPOINT = (9).to_bytes(32, "little")
+
+
+def pure_x25519(private: bytes, peer_public: bytes) -> bytes:
+    """The pure-Python ladder, bypassing hostfallback's native routing."""
+    saved = hf._native
+    hf._native = None
+    try:
+        return hf.x25519(private, peer_public)
+    finally:
+        hf._native = saved
+
+
+def test_rfc7748_scalarmult_vectors_all_engines():
+    for k_hex, u_hex, want_hex in (VEC1, VEC2):
+        k, u, want = (bytes.fromhex(h) for h in (k_hex, u_hex, want_hex))
+        assert hb.x25519(k, u) == want
+        assert pure_x25519(k, u) == want
+        assert hf.x25519(k, u) == want  # the routed entry point
+        if openssl_x25519 is not None:
+            assert openssl_x25519(k, u) == want
+
+
+def test_rfc7748_diffie_hellman_vector():
+    alice, bob = bytes.fromhex(DH_ALICE), bytes.fromhex(DH_BOB)
+    assert hb.x25519(alice, BASEPOINT) == bytes.fromhex(DH_ALICE_PUB)
+    assert hb.x25519(bob, BASEPOINT) == bytes.fromhex(DH_BOB_PUB)
+    k = bytes.fromhex(DH_K)
+    assert hb.x25519(alice, bytes.fromhex(DH_BOB_PUB)) == k
+    assert hb.x25519(bob, bytes.fromhex(DH_ALICE_PUB)) == k
+
+
+def test_rfc7748_iterated_ladder():
+    """§5.2 iteration test, 1 and 1000 rounds (1000 is ~70 ms native;
+    the 1M-round variant stays out of tier 1)."""
+    k = u = BASEPOINT
+    k, u = hb.x25519(k, u), k
+    assert k == bytes.fromhex(
+        "422c8e7a6227d7bca1350b3e2bb7279f7897b87bb6854b783c60e80311ae3079"
+    )
+    for _ in range(999):
+        k, u = hb.x25519(k, u), k
+    assert k == bytes.fromhex(
+        "684cf59ba83309552800ef566f2f4d3c1c3887c49360e3875f2eb94d99532c51"
+    )
+
+
+def test_hundred_seed_random_agreement():
+    """100 random (scalar, point) pairs: native == pure Python (== OpenSSL
+    when present) bit for bit — including points with bit 255 set, which
+    RFC 7748 masks."""
+    rng = random.Random(20260807)
+    for i in range(100):
+        priv = rng.randbytes(32)
+        peer = bytearray(hb.x25519(rng.randbytes(32), BASEPOINT))
+        if i % 3 == 0:
+            peer[31] |= 0x80  # the masked bit must not change the result
+        peer = bytes(peer)
+        n = hb.x25519(priv, peer)
+        assert n == pure_x25519(priv, peer), i
+        if openssl_x25519 is not None:
+            assert n == openssl_x25519(priv, peer), i
+
+
+def test_rejection_policy_identical():
+    """Both engines refuse the same bytes: wrong lengths raise ValueError
+    at the shared wrapper seam, and a small-order peer point (all-zero
+    shared secret) raises on the native-routed path exactly as on the
+    pure-Python path."""
+    with pytest.raises(ValueError):
+        hf.x25519(b"\x00" * 31, BASEPOINT)
+    with pytest.raises(ValueError):
+        hf.x25519(b"\x00" * 32, b"\x00" * 33)
+    zero_point = (0).to_bytes(32, "little")  # order-1/2 family: K = 0
+    with pytest.raises(ValueError):
+        hf.x25519(b"\x42" * 32, zero_point)
+    with pytest.raises(ValueError):
+        pure_x25519(b"\x42" * 32, zero_point)
+
+
+def test_mixed_engine_handshake_derives_same_key():
+    """One side native, one side pure Python: crypto/session.derive_key
+    must produce the SAME MAC key — the mixed-cluster contract the
+    session layer documents."""
+    hs_a = session_crypto.new_handshake()
+    hs_b = session_crypto.new_handshake()
+    key_native = session_crypto.derive_key(
+        hs_a, hs_b.public_bytes, hs_b.nonce, "client-1", "server-1", True
+    )
+    saved = hf._native
+    hf._native = None
+    try:
+        key_pure = session_crypto.derive_key(
+            hs_b, hs_a.public_bytes, hs_a.nonce, "client-1", "server-1", False
+        )
+    finally:
+        hf._native = saved
+    assert key_native == key_pure
+
+
+def test_native_handshake_speedup_in_record():
+    """The acceptance gate's in-record measurement: the native ladder must
+    cut per-operation handshake CPU >= 5x vs the pure-Python ladder this
+    host otherwise runs (the r18 benchmark record repeats this at
+    handshake-storm shape)."""
+    import time
+
+    k, u = bytes.fromhex(VEC1[0]), bytes.fromhex(VEC1[1])
+    t0 = time.perf_counter()
+    for _ in range(100):
+        hb.x25519(k, u)
+    native_s = (time.perf_counter() - t0) / 100
+    t0 = time.perf_counter()
+    for _ in range(10):
+        pure_x25519(k, u)
+    pure_s = (time.perf_counter() - t0) / 10
+    assert pure_s / native_s >= 5.0, (native_s, pure_s)

@@ -216,6 +216,43 @@ def test_engine_dimension_drawn_and_gated_on_durable():
     assert (True, "wal") in engines
 
 
+def test_fastpath_dimension_draws_both_postures():
+    """Generator v3's fastpath stream: both verification postures actually
+    drawn (the signed-everything wire keeps soak weight), riding a NEW
+    stream so existing components' draws did not shift."""
+    postures = {draw_spec(seed).fast_path for seed in range(32)}
+    assert postures == {True, False}
+
+
+def test_pinned_seed_fast_path_on_posture_lands_cluster_wide():
+    """Round-18 posture pin, fast path ON (seed 4 draws fast_path=True —
+    re-pin the seed if the draw ever shifts): the MAC'd-session wire runs
+    a full scenario with invariants held, and the drawn posture actually
+    landed on every replica and client."""
+    spec = draw_spec(4)
+    assert spec.fast_path is True, spec
+    res = run_scenario(spec)
+    assert res.ok, (res.error, res.violations)
+    assert any("fast_path=True" in s for s in res.steps), res.steps
+    assert res.info["fast_path_postures"] == {
+        "spec": True, "replicas": [True], "clients": [True],
+    }
+
+
+def test_pinned_seed_fast_path_off_posture_lands_cluster_wide():
+    """Round-18 posture pin, fast path OFF (seed 11 draws
+    fast_path=False): the pre-r18 signed-everything wire stays a
+    first-class soak posture — spec-pinned, immune to MOCHI_FAST_PATH."""
+    spec = draw_spec(11)
+    assert spec.fast_path is False, spec
+    res = run_scenario(spec)
+    assert res.ok, (res.error, res.violations)
+    assert any("fast_path=False" in s for s in res.steps), res.steps
+    assert res.info["fast_path_postures"] == {
+        "spec": False, "replicas": [False], "clients": [False],
+    }
+
+
 # ------------------------------------------------------------- violation arc
 
 

@@ -303,8 +303,20 @@ LIVE_MUTATIONS = [
      "if not self._auth_mac(env):",
      "if not bool(env):",
      "-apply"),
+    # round 18: sync-adopt checks the aggregate fast edge first, then the
+    # attributing per-grant audit — dropping BOTH must convict the sink
+    # (the memo warm-up gather above the loop discards its result, so it
+    # alone cannot launder the entry)
     ("mochi_tpu/server/replica.py",
-     "checked = await self._check_certificate(entry.certificate)",
+     "checked = await self._check_certificate_fast(\n"
+     "                        entry.certificate\n"
+     "                    )\n"
+     "                    if checked is None:\n"
+     "                        # fast path off, aggregate ineligible, or a failed\n"
+     "                        # aggregate: the attributing per-grant audit\n"
+     "                        checked = await self._check_certificate(\n"
+     "                            entry.certificate\n"
+     "                        )",
      "checked = entry.certificate",
      "sync-adopt"),
     # round 17: the paged engine's fault path — drop the per-entry recheck
